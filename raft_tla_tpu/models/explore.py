@@ -1,0 +1,224 @@
+"""Oracle-side explicit-state BFS: the executable semantics of TLC's worker
+loop (SURVEY §3.1) in plain Python.
+
+This is deliberately the *simple, trustworthy* implementation: the TPU engine
+in engine/ is differentially tested against it (same distinct-state counts,
+same invariant verdicts, same reachable sets on small configs).
+
+TLC semantics replicated here:
+  * Fingerprint identity = VIEW = the 10 semantic vars, NOT history
+    (raft.cfg:30, SURVEY §2.2); first-seen state keeps its history.
+  * SYMMETRY: canonicalization under server permutations (raft.cfg:29).
+    When InitServer ⊊ Server we restrict to the subgroup that fixes
+    InitServer setwise — Permutations(Server) as the reference declares
+    would be unsound there (InitServer is a constant; see SURVEY §2.10).
+  * CONSTRAINT: violating states are checked but not expanded.
+  * ACTION_CONSTRAINT: violating transitions are not generated.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..config import CONFIG_ENTRY, NIL, ModelConfig
+from . import predicates
+from .raft import (Hist, State, init_state, successors,
+                   _SRC_DST, MT_RVRESP, MT_AEREQ, MT_CATREQ, MT_COC)
+
+
+# ---------------------------------------------------------------------------
+# Symmetry canonicalization (raft.tla:1281, raft.cfg:29)
+# ---------------------------------------------------------------------------
+
+def symmetry_perms(cfg: ModelConfig) -> List[Tuple[int, ...]]:
+    """Permutations of 0..n-1 fixing InitServer setwise (sound subgroup of
+    the reference's Permutations(Server); identical when Server=InitServer)."""
+    n = cfg.n_servers
+    inside = [i for i in range(n) if cfg.init_mask >> i & 1]
+    outside = [i for i in range(n) if not (cfg.init_mask >> i & 1)]
+    perms = []
+    for pi in itertools.permutations(inside):
+        for po in itertools.permutations(outside):
+            sigma = [0] * n
+            for a, b in zip(inside, pi):
+                sigma[a] = b
+            for a, b in zip(outside, po):
+                sigma[a] = b
+            perms.append(tuple(sigma))
+    return perms
+
+
+def _perm_mask(mask: int, sigma, n: int) -> int:
+    out = 0
+    for i in range(n):
+        if mask >> i & 1:
+            out |= 1 << sigma[i]
+    return out
+
+
+def _perm_entry(e, sigma, n):
+    term, etype, payload = e
+    if etype == CONFIG_ENTRY:
+        payload = _perm_mask(payload, sigma, n)
+    return (term, etype, payload)
+
+
+def _perm_entries(es, sigma, n):
+    return tuple(_perm_entry(e, sigma, n) for e in es)
+
+
+def _perm_msg(m, sigma, n):
+    t = m[0]
+    m = list(m)
+    si, di = _SRC_DST[t]
+    m[si] = sigma[m[si]]
+    m[di] = sigma[m[di]]
+    if t == MT_RVRESP:
+        m[3] = _perm_entries(m[3], sigma, n)     # mlog
+    elif t in (MT_AEREQ, MT_CATREQ):
+        m[3 if t == MT_CATREQ else 4] = _perm_entries(
+            m[3 if t == MT_CATREQ else 4], sigma, n)
+    elif t == MT_COC:
+        m[3] = sigma[m[3]]                        # mserver
+    return tuple(m)
+
+
+def relabel(sv: State, sigma, cfg: ModelConfig) -> State:
+    """Apply server relabeling sigma (old id -> new id) to every lane of the
+    state, including inside packed messages and set bitmasks (SURVEY §7.4
+    hard part 1)."""
+    n = cfg.n_servers
+    inv = [0] * n
+    for i in range(n):
+        inv[sigma[i]] = i
+
+    def pt(t):                   # permute a per-server tuple
+        return tuple(t[inv[k]] for k in range(n))
+
+    return State(
+        ct=pt(sv.ct),
+        st=pt(sv.st),
+        vf=tuple(NIL if sv.vf[inv[k]] == NIL else sigma[sv.vf[inv[k]]]
+                 for k in range(n)),
+        log=tuple(_perm_entries(sv.log[inv[k]], sigma, n) for k in range(n)),
+        ci=pt(sv.ci),
+        vr=tuple(_perm_mask(sv.vr[inv[k]], sigma, n) for k in range(n)),
+        vg=tuple(_perm_mask(sv.vg[inv[k]], sigma, n) for k in range(n)),
+        ni=tuple(tuple(sv.ni[inv[k]][inv[l]] for l in range(n))
+                 for k in range(n)),
+        mi=tuple(tuple(sv.mi[inv[k]][inv[l]] for l in range(n))
+                 for k in range(n)),
+        msgs=tuple(sorted((_perm_msg(m, sigma, n), c) for m, c in sv.msgs)),
+    )
+
+
+def canonicalize(sv: State, perms, cfg: ModelConfig) -> State:
+    """Min-over-permutations canonical representative.  States are plain
+    nested tuples of ints (the absent-mcommitIndex field is the int -1), so
+    the natural tuple order is total."""
+    return min(relabel(sv, s, cfg) for s in perms)
+
+
+# ---------------------------------------------------------------------------
+# BFS driver
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Violation:
+    invariant: str
+    state: State
+    hist: Hist
+    trace: Optional[List[str]] = None
+
+
+@dataclass
+class ExploreResult:
+    distinct_states: int
+    generated_states: int
+    depth: int
+    violations: List[Violation] = field(default_factory=list)
+    level_sizes: List[int] = field(default_factory=list)
+    # key -> (State, Hist); only retained if keep_states=True
+    states: Optional[Dict] = None
+
+
+def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
+            max_states: int = 10 ** 9, keep_states: bool = False,
+            stop_on_violation: bool = False,
+            trace_violations: bool = False) -> ExploreResult:
+    """Level-synchronous BFS from Init (SURVEY §3.1)."""
+    perms = symmetry_perms(cfg) if cfg.symmetry else None
+    inv_fns = [(nm, predicates.resolve_invariant(nm, cfg))
+               for nm in cfg.invariants]
+    con_fns = [predicates.CONSTRAINTS[nm] for nm in cfg.constraints]
+    act_fns = [predicates.ACTION_CONSTRAINTS[nm]
+               for nm in cfg.action_constraints]
+
+    def key_of(sv: State):
+        if perms:
+            sv = canonicalize(sv, perms, cfg)
+        return sv
+
+    sv0, h0 = init_state(cfg)
+    k0 = key_of(sv0)
+    seen: Dict = {k0: (sv0, h0)}
+    parent: Dict = {k0: (None, None)}
+    result = ExploreResult(distinct_states=1, generated_states=1, depth=0)
+
+    def check(sv, h, k):
+        for nm, fn in inv_fns:
+            if not fn(sv, h, cfg):
+                v = Violation(nm, sv, h)
+                if trace_violations:
+                    v.trace = _trace_to(k, parent)
+                result.violations.append(v)
+                if stop_on_violation:
+                    return False
+        return True
+
+    if not check(sv0, h0, k0) and stop_on_violation:
+        result.states = seen if keep_states else None
+        return result
+
+    frontier = [(sv0, h0, k0)] if all(f(sv0, h0, cfg) for f in con_fns) else []
+    depth = 0
+    while frontier and depth < max_depth and len(seen) < max_states:
+        depth += 1
+        nxt = []
+        for sv, h, k in frontier:
+            for label, sv2, h2 in successors(sv, h, cfg):
+                if act_fns and not all(f(sv, h, sv2, h2, cfg)
+                                       for f in act_fns):
+                    continue
+                result.generated_states += 1
+                k2 = key_of(sv2)
+                if k2 in seen:
+                    continue
+                seen[k2] = (sv2, h2)
+                parent[k2] = (k, label)
+                if not check(sv2, h2, k2) and stop_on_violation:
+                    result.distinct_states = len(seen)
+                    result.depth = depth
+                    result.states = seen if keep_states else None
+                    return result
+                if all(f(sv2, h2, cfg) for f in con_fns):
+                    nxt.append((sv2, h2, k2))
+        result.level_sizes.append(len(nxt))
+        frontier = nxt
+    result.distinct_states = len(seen)
+    result.depth = depth
+    result.states = seen if keep_states else None
+    return result
+
+
+def _trace_to(k, parent) -> List[str]:
+    out = []
+    while True:
+        pk, label = parent[k]
+        if pk is None:
+            break
+        out.append(label)
+        k = pk
+    return list(reversed(out))

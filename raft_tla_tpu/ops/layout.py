@@ -1,0 +1,219 @@
+"""Packed-state layout: field widths and word offsets, computed from bounds.
+
+The packed state is a vector of ``n_words`` uint32 lanes per state
+(SURVEY §7.1).  All field widths are derived from the ModelConfig bounds so
+the layout is provably wide enough; tests assert round-trip identity against
+the oracle representation.
+
+Layout (word offsets in order):
+  [VIEW region — hashed for the fingerprint, raft.cfg:30 `VIEW vars`]
+    server words   : S words   — term | role | votedFor | commitIndex | logLen
+    vote words     : S words   — votesResponded mask | votesGranted mask
+    next/match     : ceil(S*S/2) words — (nextIndex, matchIndex) byte pairs
+    log entries    : S * ceil(Lcap/2) words — u16 entries, 2 per word
+    bag slots      : K * msg_words words — packed messages, slots sorted
+                     by packed value so the (unordered) bag has a unique
+                     representation (SURVEY §7.1 "load-bearing for dedup")
+    bag counts     : ceil(K/4) words — u8 copy counts per slot
+  [NON-VIEW region — history counters & scenario features, SURVEY §2.2:
+   part of the successor computation and of constraint/scenario predicates,
+   but excluded from state identity]
+    history words  : per-server restarted|timeout nibbles, hadNum* nibbles
+    feature words  : globalLen, scenario flags, restart positions …
+
+A log entry packs as  term | etype | payload  in ``entry_bits`` (payload is
+the value *index* for ValueEntry, the config bitmask for ConfigEntry —
+raft.tla:20, 115).
+
+A message packs into ``msg_words`` u32 words:
+  word layout: mtype(3) | mterm | msource | mdest | type-specific fields,
+  then up to Lmax log entries (mentries / mlog).  Absent optional fields
+  (the follow-up CatchupRequest's missing mcommitIndex, raft.tla:762-771)
+  get a dedicated presence bit so field-set identity is preserved.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+
+from ..config import ModelConfig
+
+
+def bits_for(maxval: int) -> int:
+    b = 1
+    while (1 << b) <= maxval:
+        b += 1
+    return b
+
+
+@dataclass(frozen=True)
+class Layout:
+    cfg: ModelConfig
+
+    # ---- scalar field widths -------------------------------------------
+    @cached_property
+    def S(self):
+        return self.cfg.n_servers
+
+    @cached_property
+    def Lmax(self):
+        # max entries ever carried in a message / appended at once
+        return self.cfg.bounds.max_log_length
+
+    @cached_property
+    def Lcap(self):
+        # max representable per-server log (post-splice, pre-pruning)
+        return self.cfg.log_capacity
+
+    @cached_property
+    def K(self):
+        return self.cfg.bag_capacity
+
+    @cached_property
+    def term_bits(self):
+        # terms reach max_terms + 1 before BoundedTerms prunes expansion
+        return bits_for(self.cfg.bounds.max_terms + 1)
+
+    @cached_property
+    def server_bits(self):
+        # votedFor needs Nil: encode Nil as S (so range is 0..S)
+        return bits_for(self.S)
+
+    @cached_property
+    def index_bits(self):
+        # log indices / commitIndex / nextIndex / matchIndex: up to Lcap+1
+        return bits_for(self.Lcap + 1)
+
+    @cached_property
+    def value_bits(self):
+        # payload: value index (0..V-1) or config bitmask (S bits)
+        return max(bits_for(max(len(self.cfg.values) - 1, 1)), self.S)
+
+    @cached_property
+    def entry_bits(self):
+        return self.term_bits + 1 + self.value_bits
+
+    @cached_property
+    def count_bits(self):
+        # bag copy count <= total cardinality <= K
+        return bits_for(self.K)
+
+    @cached_property
+    def rounds_bits(self):
+        return bits_for(max(self.cfg.num_rounds, 1))
+
+    # ---- message packing ------------------------------------------------
+    # Per-type payload bit budgets (header = type+term+src+dst is shared).
+    @cached_property
+    def msg_header_bits(self):
+        return 3 + self.term_bits + self.server_bits + self.server_bits
+
+    @cached_property
+    def msg_payload_bits(self):
+        tb, ib, eb, rb = (self.term_bits, self.index_bits, self.entry_bits,
+                          self.rounds_bits)
+        nbits = bits_for(self.Lmax)          # mentries length field
+        per_type = {
+            # RVReq: mlastLogTerm, mlastLogIndex            (raft.tla:434-439)
+            "rvreq": tb + ib,
+            # RVResp: granted, |mlog|, mlog                  (raft.tla:588-596)
+            "rvresp": 1 + nbits + self.Lmax * eb,
+            # AEReq: prevIdx, prevTerm, nentries(0/1), entry, commitIdx
+            "aereq": ib + tb + 1 + eb + ib,
+            # AEResp: success, matchIdx                      (raft.tla:648-654)
+            "aeresp": 1 + ib,
+            # CatReq: logLen, nentries, entries, commit+presence, rounds
+            "catreq": ib + nbits + self.Lmax * eb + ib + 1 + rb,
+            # CatResp: success, matchIdx, roundsLeft         (raft.tla:720-744)
+            "catresp": 1 + ib + rb,
+            # COC: madd, mserver                             (raft.tla:563-568)
+            "coc": 1 + self.server_bits,
+        }
+        return per_type
+
+    @cached_property
+    def msg_bits(self):
+        return self.msg_header_bits + max(self.msg_payload_bits.values())
+
+    @cached_property
+    def msg_words(self):
+        return (self.msg_bits + 31) // 32
+
+    # ---- word offsets ---------------------------------------------------
+    @cached_property
+    def off_server(self):
+        return 0
+
+    @cached_property
+    def off_votes(self):
+        return self.off_server + self.S
+
+    @cached_property
+    def off_nextmatch(self):
+        return self.off_votes + self.S
+
+    @cached_property
+    def nextmatch_words(self):
+        return (self.S * self.S + 1) // 2     # one u16 (next|match) per pair
+
+    @cached_property
+    def off_log(self):
+        return self.off_nextmatch + self.nextmatch_words
+
+    @cached_property
+    def log_words_per_server(self):
+        return (self.Lcap + 1) // 2           # u16 entries, 2 per word
+
+    @cached_property
+    def off_bag(self):
+        return self.off_log + self.S * self.log_words_per_server
+
+    @cached_property
+    def off_counts(self):
+        return self.off_bag + self.K * self.msg_words
+
+    @cached_property
+    def counts_words(self):
+        return (self.K + 3) // 4
+
+    @cached_property
+    def n_view_words(self):
+        return self.off_counts + self.counts_words
+
+    # non-VIEW: history counters + scenario features
+    @cached_property
+    def off_hist(self):
+        return self.n_view_words
+
+    @cached_property
+    def hist_words(self):
+        # per-server restarted(4b)+timeout(4b) packed 4 servers/word,
+        # + 1 word of hadNum{Leaders,ClientRequests,Tried,MC} bytes
+        return (self.S + 3) // 4 + 1
+
+    @cached_property
+    def off_feat(self):
+        return self.off_hist + self.hist_words
+
+    # feature lanes (see ops/features.py): globalLen u16 | flags u16,
+    # lastRestartPos u16 | minRestartGap u16, addedSet u8 | reserved
+    @cached_property
+    def feat_words(self):
+        return 3
+
+    @cached_property
+    def n_words(self):
+        return self.off_feat + self.feat_words
+
+    def describe(self) -> str:
+        return (f"Layout(S={self.S}, Lcap={self.Lcap}, K={self.K}, "
+                f"msg_words={self.msg_words}, view={self.n_view_words}w, "
+                f"total={self.n_words}w = {4 * self.n_words}B/state)")
+
+    def __post_init__(self):
+        assert self.entry_bits <= 16, "log entry must fit u16"
+        assert self.term_bits + 2 + self.server_bits + 2 * self.index_bits \
+            <= 32, "server word overflow"
+        assert 2 * self.index_bits <= 16, "next/match pair must fit u16"
+        assert self.count_bits <= 8, "bag count must fit u8"

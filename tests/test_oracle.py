@@ -1,0 +1,215 @@
+"""Tests for the plain-Python oracle (models/raft.py, explore.py).
+
+The key fixture is a semantic replay to a 2-concurrent-leaders state — the
+reference documents that such a state is reachable (shortest NextAsync trace
+has length 20, tlc_membership/raft.tla:1179-1181).  We drive the oracle's
+successor function by action label, which exercises elections, vote handling
+and BecomeLeader end-to-end.
+"""
+
+import pytest
+
+from raft_tla_tpu.config import (Bounds, LEADER, CANDIDATE, FOLLOWER,
+                                 ModelConfig, NEXT_ASYNC, NEXT_ASYNC_CRASH,
+                                 NEXT_DYNAMIC, NIL)
+from raft_tla_tpu.models.explore import (canonicalize, explore, relabel,
+                                         symmetry_perms)
+from raft_tla_tpu.models.raft import init_state, successors
+from raft_tla_tpu.models import predicates
+
+
+def apply_label(sv, h, cfg, label):
+    matches = [(l, s2, h2) for l, s2, h2 in successors(sv, h, cfg)
+               if l == label]
+    assert matches, f"no successor labelled {label}"
+    assert len(matches) == 1, f"ambiguous label {label}"
+    return matches[0][1], matches[0][2]
+
+
+CFG3 = ModelConfig(n_servers=3, init_servers=(0, 1, 2), values=(1, 2),
+                   next_family=NEXT_ASYNC)
+
+# An election of server `a` at a fresh term, voters = a (self) and b.
+# b must first adopt a's term via UpdateTerm (raft.tla:826-832) — the
+# request handler only fires once m.mterm <= currentTerm[i] (raft.tla:585).
+def election(a, b):
+    return [
+        f"Timeout({a})",
+        f"RequestVote({a},{a})",
+        f"RequestVote({a},{b})",
+        f"HandleRVReq({a}<-{a})",
+        f"HandleRVResp({a}<-{a})",
+        f"UpdateTerm({b})",
+        f"HandleRVReq({b}<-{a})",
+        f"HandleRVResp({a}<-{b})",
+        f"BecomeLeader({a})",
+    ]
+
+
+def test_two_concurrent_leaders_reachable():
+    """Reproduces the reference's ConcurrentLeaders scenario
+    (raft.tla:1158, 1179-1181): elect s0 at term 2 with votes {s0,s1},
+    then elect s1 at term 3 with votes {s1,s2}; s0 stays Leader."""
+    sv, h = init_state(CFG3)
+    for lbl in election(0, 1) + election(1, 2):
+        sv, h = apply_label(sv, h, CFG3, lbl)
+    assert sv.st[0] == LEADER and sv.st[1] == LEADER
+    assert sv.ct == (2, 3, 3)
+    assert h.nleaders == 2
+    assert h.timeout == (1, 1, 0)
+    # ConcurrentLeaders scenario property is violated here (that's the
+    # point of the property: a violation is the witness trace).
+    assert not predicates.concurrent_leaders(sv, h, CFG3)
+    # And the real safety invariants still hold.
+    for nm in CFG3.invariants:
+        assert predicates.INVARIANTS[nm](sv, h, CFG3), nm
+    # BecomeLeader(1) logged both leaders (raft.tla:483).
+    bl = [r for r in h.glob if r[0] == "BecomeLeader"]
+    assert bl[-1][2] == 0b011
+
+
+def test_update_term_does_not_consume_message():
+    """UpdateTerm leaves the message in the bag (raft.tla:831)."""
+    sv, h = init_state(CFG3)
+    for lbl in ["Timeout(0)", "RequestVote(0,1)"]:
+        sv, h = apply_label(sv, h, CFG3, lbl)
+    bag_before = sv.msgs
+    sv2, h2 = apply_label(sv, h, CFG3, "UpdateTerm(1)")
+    assert sv2.msgs == bag_before
+    assert sv2.ct[1] == 2 and sv2.st[1] == FOLLOWER and sv2.vf[1] == NIL
+    # After adopting the term, the request can still be handled.
+    sv3, h3 = apply_label(sv2, h2, CFG3, "HandleRVReq(1<-0)")
+    assert sv3.vf[1] == 0
+
+
+def test_restart_keeps_stable_storage():
+    cfg = CFG3.with_(next_family=NEXT_ASYNC_CRASH)
+    sv, h = init_state(cfg)
+    for lbl in election(0, 1) + ["ClientRequest(0,1)"]:
+        sv, h = apply_label(sv, h, cfg, lbl)
+    sv2, h2 = apply_label(sv, h, cfg, "Restart(0)")
+    # Keeps currentTerm, votedFor, log; resets the rest (raft.tla:401-411).
+    assert sv2.ct[0] == sv.ct[0]
+    assert sv2.vf[0] == sv.vf[0]
+    assert sv2.log[0] == sv.log[0]
+    assert sv2.st[0] == FOLLOWER and sv2.ci[0] == 0
+    assert sv2.ni[0] == (1, 1, 1) and sv2.mi[0] == (0, 0, 0)
+    assert h2.restarted == (1, 0, 0)
+
+
+def test_replication_and_commit():
+    """§3.3 call stack: ClientRequest → AppendEntries → accept → response →
+    AdvanceCommitIndex."""
+    cfg = CFG3
+    sv, h = init_state(cfg)
+    for lbl in election(0, 1) + ["ClientRequest(0,1)",
+                                 "AppendEntries(0,1)",
+                                 "AENoConflict(1)"]:
+        sv, h = apply_label(sv, h, cfg, lbl)
+    assert sv.log[1] == sv.log[0]
+    # NoConflict did NOT consume the request nor reply (raft.tla:668-672);
+    # reprocessing it now hits AlreadyDone, which replies.
+    sv, h = apply_label(sv, h, cfg, "AEAlreadyDone(1)")
+    sv, h = apply_label(sv, h, cfg, "HandleAEResp(0<-1)")
+    assert sv.mi[0][1] == 1 and sv.ni[0][1] == 2
+    sv, h = apply_label(sv, h, cfg, "AdvanceCommitIndex(0)")
+    assert sv.ci[0] == 1
+    assert h.glob[-1][0] == "CommitEntry"
+
+
+def test_membership_add_end_to_end():
+    """§3.4 call stack: AddNewServer → catchup → CheckOldConfig → ConfigEntry
+    append, on Server={0..3}, InitServer={0,1,2}."""
+    cfg = ModelConfig(n_servers=4, init_servers=(0, 1, 2), values=(1,),
+                      next_family=NEXT_DYNAMIC)
+    sv, h = init_state(cfg)
+    for lbl in election(0, 1):
+        sv, h = apply_label(sv, h, cfg, lbl)
+    sv, h = apply_label(sv, h, cfg, "AddNewServer(0,3)")
+    assert h.ntried == 1
+    assert h.glob[-2][0] == "TryAddServer"
+    sv, h = apply_label(sv, h, cfg, "CatReqOk(3)")
+    sv, h = apply_label(sv, h, cfg, "CatRespDone(0)")   # NumRounds=1
+    sv, h = apply_label(sv, h, cfg, "CocApply(0)")
+    assert sv.log[0][-1][1] == 1                         # ConfigEntry
+    assert sv.log[0][-1][2] == 0b1111                    # {0,1,2,3}
+    assert h.nmc == 1
+    assert h.glob[-1][0] == "AddServer"
+    # Timeout guard: the added server may now campaign only per ITS OWN
+    # config view, which is still InitServer (its log lacks the entry).
+    assert not any(l == "Timeout(3)" for l, _, _ in successors(sv, h, cfg))
+
+
+def test_catchup_multiple_rounds_bag_stays_orderable():
+    """Regression: the follow-up CatchupRequest's absent mcommitIndex field
+    (encoded -1, raft.tla:762-771) must coexist in the bag with an
+    AddNewServer CatchupRequest (which has the field) without breaking the
+    canonical bag sort."""
+    cfg = ModelConfig(n_servers=4, init_servers=(0, 1, 2), values=(1,),
+                      next_family=NEXT_DYNAMIC, num_rounds=2)
+    sv, h = init_state(cfg)
+    for lbl in election(0, 1) + ["AddNewServer(0,3)", "CatReqOk(3)",
+                                 "CatRespMore(0)",       # rounds 2 -> 1
+                                 "AddNewServer(0,3)"]:   # second, with field
+        sv, h = apply_label(sv, h, cfg, lbl)
+    kinds = sorted(m[4] for m, _ in sv.msgs if m[0] == 5)  # MT_CATREQ mcommit
+    assert kinds == [-1, 0]
+    # and canonicalization over the bag still works
+    perms = symmetry_perms(cfg)
+    canonicalize(sv, perms, cfg)
+    # both in-flight requests are receivable (two CatReqOk(3) successors)
+    n_catreqok = sum(1 for l, _, _ in successors(sv, h, cfg)
+                     if l == "CatReqOk(3)")
+    assert n_catreqok == 2
+
+
+def test_coc_discard_and_process_both_enabled():
+    """The HandleCheckOldConfig guard quirk (raft.tla:796): for a Leader at
+    the message's term, discard AND process are both enabled."""
+    cfg = ModelConfig(n_servers=3, init_servers=(0, 1, 2), values=(1,),
+                      next_family=NEXT_DYNAMIC)
+    sv, h = init_state(cfg)
+    for lbl in election(0, 1):
+        sv, h = apply_label(sv, h, cfg, lbl)
+    sv, h = apply_label(sv, h, cfg, "DeleteServer(0,2)")
+    labels = [l for l, _, _ in successors(sv, h, cfg)]
+    assert "CocDiscard(0)" in labels and "CocApply(0)" in labels
+
+
+def test_symmetry_relabel_roundtrip():
+    cfg = CFG3
+    sv, h = init_state(cfg)
+    for lbl in election(0, 1) + ["ClientRequest(0,2)", "AppendEntries(0,2)"]:
+        sv, h = apply_label(sv, h, cfg, lbl)
+    perms = symmetry_perms(cfg)
+    assert len(perms) == 6
+    for sigma in perms:
+        rl = relabel(sv, sigma, cfg)
+        # canonical form is permutation-invariant
+        assert canonicalize(rl, perms, cfg) == canonicalize(sv, perms, cfg)
+    # identity perm is a no-op
+    assert relabel(sv, (0, 1, 2), cfg) == sv
+
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,), next_family=NEXT_ASYNC,
+    symmetry=False, max_inflight_override=2,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+
+def test_micro_bfs_deterministic_and_symmetry_consistent():
+    r1 = explore(MICRO)
+    r2 = explore(MICRO)
+    assert r1.distinct_states == r2.distinct_states
+    assert r1.violations == [] and r2.violations == []
+    rs = explore(MICRO.with_(symmetry=True))
+    assert rs.violations == []
+    assert rs.distinct_states <= r1.distinct_states
+    assert r1.distinct_states <= 2 * rs.distinct_states
+
+
+def test_micro_bfs_crash_family_grows_space():
+    r_async = explore(MICRO)
+    r_crash = explore(MICRO.with_(next_family=NEXT_ASYNC_CRASH))
+    assert r_crash.distinct_states > r_async.distinct_states
