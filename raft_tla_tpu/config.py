@@ -146,6 +146,10 @@ class ModelConfig:
     # Override for MaxInFlightMessages (raft.tla:30 derives 2*|Server|^2).
     # The reference requires editing the spec for this; we lift it.
     max_inflight_override: int = None
+    # 128-bit fingerprints (two independent 64-bit streams).  TLC runs with
+    # 64-bit fingerprints and ~1e-9 collision odds; exhaustive-parity runs
+    # can opt into 128 (SURVEY §7.4 hard part 4).
+    fp128: bool = False
 
     @property
     def init_mask(self) -> int:

@@ -1,35 +1,30 @@
-"""Packed-state layout: field widths and word offsets, computed from bounds.
+"""Packed-state layout: field widths and packing spec, computed from bounds.
 
-The packed state is a vector of ``n_words`` uint32 lanes per state
-(SURVEY §7.1).  All field widths are derived from the ModelConfig bounds so
-the layout is provably wide enough; tests assert round-trip identity against
-the oracle representation.
+Device representation (SURVEY.md §7.1, revised for SoA):  a state is a
+struct-of-arrays pytree rather than one bit-packed word vector — XLA
+vectorizes per-field int32 arrays well and the kernels stay readable —
+with bit-packing used exactly where it is load-bearing:
 
-Layout (word offsets in order):
-  [VIEW region — hashed for the fingerprint, raft.cfg:30 `VIEW vars`]
-    server words   : S words   — term | role | votedFor | commitIndex | logLen
-    vote words     : S words   — votesResponded mask | votesGranted mask
-    next/match     : ceil(S*S/2) words — (nextIndex, matchIndex) byte pairs
-    log entries    : S * ceil(Lcap/2) words — u16 entries, 2 per word
-    bag slots      : K * msg_words words — packed messages, slots sorted
-                     by packed value so the (unordered) bag has a unique
-                     representation (SURVEY §7.1 "load-bearing for dedup")
-    bag counts     : ceil(K/4) words — u8 copy counts per slot
-  [NON-VIEW region — history counters & scenario features, SURVEY §2.2:
-   part of the successor computation and of constraint/scenario predicates,
-   but excluded from state identity]
-    history words  : per-server restarted|timeout nibbles, hadNum* nibbles
-    feature words  : globalLen, scenario flags, restart positions …
+  * **log entries** pack to one small int each (``entry_bits`` ≤ 16):
+    ``term | etype | payload`` — so entry equality (LogMatching, the
+    AppendEntries conflict test) is a single integer compare
+    (reference entry schema: tlc_membership/raft.tla:115, 153-155).
+  * **messages** pack to ``msg_words`` uint32 words per bag slot: a
+    header word (type/term/src/dst/3 generic fields/entry-count) plus
+    entry words.  Field-set identity (the follow-up CatchupRequest's
+    *absent* mcommitIndex, raft.tla:762-771) is preserved by storing
+    every generic field with a +1 offset so "absent" = -1 = stored 0.
 
-A log entry packs as  term | etype | payload  in ``entry_bits`` (payload is
-the value *index* for ValueEntry, the config bitmask for ConfigEntry —
-raft.tla:20, 115).
+State *identity* (VIEW semantics, raft.cfg:30) is established by a
+64/128-bit fingerprint, not by canonical bytes:  the message bag is
+hashed **commutatively** (sum over slots of ``count * mix(words)``), so
+slot order — and even a message split across two slots — never affects
+identity, and no canonical bag sort is required anywhere (the TypedBags
+(+)/(-) semantics of raft.tla:226-231 are then free).  Symmetry
+(raft.cfg:29) is the min of the fingerprint over server relabelings.
 
-A message packs into ``msg_words`` u32 words:
-  word layout: mtype(3) | mterm | msource | mdest | type-specific fields,
-  then up to Lmax log entries (mentries / mlog).  Absent optional fields
-  (the follow-up CatchupRequest's missing mcommitIndex, raft.tla:762-771)
-  get a dedicated presence bit so field-set identity is preserved.
+All widths derive from ModelConfig bounds; tests assert round-trip
+identity against the oracle representation.
 """
 
 from __future__ import annotations
@@ -37,183 +32,214 @@ from __future__ import annotations
 from dataclasses import dataclass
 from functools import cached_property
 
-from ..config import ModelConfig
+import numpy as np
+
+from ..config import (MT_AEREQ, MT_AERESP, MT_CATREQ, MT_CATRESP, MT_COC,
+                      MT_RVREQ, MT_RVRESP, ModelConfig)
 
 
 def bits_for(maxval: int) -> int:
+    """Bits needed to store values 0..maxval."""
     b = 1
     while (1 << b) <= maxval:
         b += 1
     return b
 
 
+# Generic message-field mapping (type tag -> which oracle tuple positions
+# land in generic fields a, b, c).  src/dst positions come from the oracle's
+# own table (models/raft.py _SRC_DST) so there is one source of truth.
+#   RVREQ   (t, term, lastLogTerm, lastLogIndex, src, dst)       a=llt b=lli
+#   RVRESP  (t, term, granted, mlog, src, dst)                   a=granted
+#   AEREQ   (t, term, prevIdx, prevTerm, entries, mcommit, s, d) a=pi b=pt c=mc
+#   AERESP  (t, term, success, matchIdx, src, dst)               a=succ b=mi
+#   CATREQ  (t, term, logLen, entries, mcommit, src, dst, rnds)  a=ll b=mc c=r
+#   CATRESP (t, term, success, matchIdx, src, dst, roundsLeft)   a=s b=mi c=rl
+#   COC     (t, term, madd, mserver, src, dst)                   a=madd b=msrv
+_ABC_ENT = {
+    MT_RVREQ:   dict(a=2, b=3, c=None, ent=None),
+    MT_RVRESP:  dict(a=2, b=None, c=None, ent=3),
+    MT_AEREQ:   dict(a=2, b=3, c=5, ent=4),
+    MT_AERESP:  dict(a=2, b=3, c=None, ent=None),
+    MT_CATREQ:  dict(a=2, b=4, c=7, ent=3),
+    MT_CATRESP: dict(a=2, b=3, c=6, ent=None),
+    MT_COC:     dict(a=2, b=3, c=None, ent=None),
+}
+
+
+def _msg_fields():
+    from ..models.raft import _SRC_DST
+    return {mt: dict(src=_SRC_DST[mt][0], dst=_SRC_DST[mt][1], **abc)
+            for mt, abc in _ABC_ENT.items()}
+
+
+MSG_FIELDS = _msg_fields()
+
+
 @dataclass(frozen=True)
 class Layout:
     cfg: ModelConfig
 
-    # ---- scalar field widths -------------------------------------------
+    # ---- dimensions -----------------------------------------------------
     @cached_property
     def S(self):
         return self.cfg.n_servers
 
     @cached_property
     def Lmax(self):
-        # max entries ever carried in a message / appended at once
+        """Max entries carried in one message (mentries/mlog ≤ one log:
+        raft.tla:444 comment limits AE to ≤1; catchup sends SubSeq of a
+        frontier log, ≤ MaxLogLength; RVResp mlog likewise)."""
         return self.cfg.bounds.max_log_length
 
     @cached_property
     def Lcap(self):
-        # max representable per-server log (post-splice, pre-pruning)
+        """Max representable per-server log: catchup splice of a ≤L prefix
+        with ≤L entries (HandleCatchupRequest raft.tla:734-736) = 2L; such
+        states are generated+checked but never expanded (CONSTRAINT
+        semantics, SURVEY §2.8)."""
         return self.cfg.log_capacity
 
     @cached_property
     def K(self):
+        """Bag slots: distinct messages ≤ BagCardinality ≤ MaxInFlight,
+        +1 headroom for the Send that overruns the bound before pruning."""
         return self.cfg.bag_capacity
 
+    # ---- scalar field widths -------------------------------------------
     @cached_property
     def term_bits(self):
-        # terms reach max_terms + 1 before BoundedTerms prunes expansion
+        # terms reach max_terms + 1 (Timeout from a max_terms state is
+        # generated, then pruned by BoundedTerms)
         return bits_for(self.cfg.bounds.max_terms + 1)
 
     @cached_property
     def server_bits(self):
-        # votedFor needs Nil: encode Nil as S (so range is 0..S)
-        return bits_for(self.S)
-
-    @cached_property
-    def index_bits(self):
-        # log indices / commitIndex / nextIndex / matchIndex: up to Lcap+1
-        return bits_for(self.Lcap + 1)
+        return bits_for(max(self.S - 1, 1))
 
     @cached_property
     def value_bits(self):
-        # payload: value index (0..V-1) or config bitmask (S bits)
-        return max(bits_for(max(len(self.cfg.values) - 1, 1)), self.S)
+        # entry payload: raw client value (raft.cfg:11 binds small ints)
+        # or a config bitmask (S bits)
+        return max(bits_for(max(self.cfg.values)), self.S)
 
     @cached_property
     def entry_bits(self):
+        # term | etype(1) | payload ; 0 == "no entry" (real terms ≥ 1)
         return self.term_bits + 1 + self.value_bits
 
     @cached_property
-    def count_bits(self):
-        # bag copy count <= total cardinality <= K
-        return bits_for(self.K)
+    def field_bits(self):
+        # generic message fields a/b/c, stored with +1 offset (absent=-1→0):
+        # values span log indices (≤ Lcap+1), terms, server ids, rounds
+        fmax = max(self.Lcap + 1, self.cfg.bounds.max_terms + 1, self.S,
+                   self.cfg.num_rounds)
+        return bits_for(fmax + 1)
 
     @cached_property
-    def rounds_bits(self):
-        return bits_for(max(self.cfg.num_rounds, 1))
+    def entlen_bits(self):
+        return bits_for(self.Lmax)
 
-    # ---- message packing ------------------------------------------------
-    # Per-type payload bit budgets (header = type+term+src+dst is shared).
+    # ---- message word packing ------------------------------------------
+    # word0 (header): mtype | mterm | msrc | mdst | a | b | c | entlen
+    # word1..      : packed entries, entries_per_word per word
     @cached_property
-    def msg_header_bits(self):
-        return 3 + self.term_bits + self.server_bits + self.server_bits
-
-    @cached_property
-    def msg_payload_bits(self):
-        tb, ib, eb, rb = (self.term_bits, self.index_bits, self.entry_bits,
-                          self.rounds_bits)
-        nbits = bits_for(self.Lmax)          # mentries length field
-        per_type = {
-            # RVReq: mlastLogTerm, mlastLogIndex            (raft.tla:434-439)
-            "rvreq": tb + ib,
-            # RVResp: granted, |mlog|, mlog                  (raft.tla:588-596)
-            "rvresp": 1 + nbits + self.Lmax * eb,
-            # AEReq: prevIdx, prevTerm, nentries(0/1), entry, commitIdx
-            "aereq": ib + tb + 1 + eb + ib,
-            # AEResp: success, matchIdx                      (raft.tla:648-654)
-            "aeresp": 1 + ib,
-            # CatReq: logLen, nentries, entries, commit+presence, rounds
-            "catreq": ib + nbits + self.Lmax * eb + ib + 1 + rb,
-            # CatResp: success, matchIdx, roundsLeft         (raft.tla:720-744)
-            "catresp": 1 + ib + rb,
-            # COC: madd, mserver                             (raft.tla:563-568)
-            "coc": 1 + self.server_bits,
-        }
-        return per_type
+    def header_shifts(self):
+        shifts = {}
+        cur = 0
+        for name, width in (("mtype", 3), ("mterm", self.term_bits),
+                            ("msrc", self.server_bits),
+                            ("mdst", self.server_bits),
+                            ("a", self.field_bits), ("b", self.field_bits),
+                            ("c", self.field_bits),
+                            ("entlen", self.entlen_bits)):
+            shifts[name] = (cur, width)
+            cur += width
+        if cur > 32:
+            raise ValueError(
+                f"message header needs {cur} bits > 32; bounds too large "
+                f"for the single-header-word packing (split packing TBD)")
+        return shifts
 
     @cached_property
-    def msg_bits(self):
-        return self.msg_header_bits + max(self.msg_payload_bits.values())
+    def entries_per_word(self):
+        return 32 // self.entry_bits
 
     @cached_property
     def msg_words(self):
-        return (self.msg_bits + 31) // 32
+        return 1 + (self.Lmax + self.entries_per_word - 1) \
+            // self.entries_per_word
 
-    # ---- word offsets ---------------------------------------------------
+    # ---- fingerprint salts ---------------------------------------------
     @cached_property
-    def off_server(self):
-        return 0
-
-    @cached_property
-    def off_votes(self):
-        return self.off_server + self.S
-
-    @cached_property
-    def off_nextmatch(self):
-        return self.off_votes + self.S
-
-    @cached_property
-    def nextmatch_words(self):
-        return (self.S * self.S + 1) // 2     # one u16 (next|match) per pair
-
-    @cached_property
-    def off_log(self):
-        return self.off_nextmatch + self.nextmatch_words
-
-    @cached_property
-    def log_words_per_server(self):
-        return (self.Lcap + 1) // 2           # u16 entries, 2 per word
-
-    @cached_property
-    def off_bag(self):
-        return self.off_log + self.S * self.log_words_per_server
-
-    @cached_property
-    def off_counts(self):
-        return self.off_bag + self.K * self.msg_words
-
-    @cached_property
-    def counts_words(self):
-        return (self.K + 3) // 4
-
-    @cached_property
-    def n_view_words(self):
-        return self.off_counts + self.counts_words
-
-    # non-VIEW: history counters + scenario features
-    @cached_property
-    def off_hist(self):
-        return self.n_view_words
-
-    @cached_property
-    def hist_words(self):
-        # per-server restarted(4b)+timeout(4b) packed 4 servers/word,
-        # + 1 word of hadNum{Leaders,ClientRequests,Tried,MC} bytes
-        return (self.S + 3) // 4 + 1
-
-    @cached_property
-    def off_feat(self):
-        return self.off_hist + self.hist_words
-
-    # feature lanes (see ops/features.py): globalLen u16 | flags u16,
-    # lastRestartPos u16 | minRestartGap u16, addedSet u8 | reserved
-    @cached_property
-    def feat_words(self):
-        return 3
-
-    @cached_property
-    def n_words(self):
-        return self.off_feat + self.feat_words
+    def n_hash_streams(self):
+        return 2 if self.cfg.fp128 else 1
 
     def describe(self) -> str:
-        return (f"Layout(S={self.S}, Lcap={self.Lcap}, K={self.K}, "
-                f"msg_words={self.msg_words}, view={self.n_view_words}w, "
-                f"total={self.n_words}w = {4 * self.n_words}B/state)")
+        return (f"Layout(S={self.S}, Lmax={self.Lmax}, Lcap={self.Lcap}, "
+                f"K={self.K}, entry_bits={self.entry_bits}, "
+                f"msg_words={self.msg_words})")
 
     def __post_init__(self):
-        assert self.entry_bits <= 16, "log entry must fit u16"
-        assert self.term_bits + 2 + self.server_bits + 2 * self.index_bits \
-            <= 32, "server word overflow"
-        assert 2 * self.index_bits <= 16, "next/match pair must fit u16"
-        assert self.count_bits <= 8, "bag count must fit u8"
+        # packed entries live in int32 log lanes: 31 usable bits
+        if self.entry_bits > 31:
+            raise ValueError(
+                f"entry_bits={self.entry_bits} exceeds the int32 log lane")
+        _ = self.header_shifts  # validate eagerly
+
+
+# ---------------------------------------------------------------------------
+# Generic (numpy / jnp polymorphic) bit-field helpers.  All shift amounts
+# and masks are static Python ints, so these trace cleanly under jit.
+# ---------------------------------------------------------------------------
+
+def get_field(word, shift_width):
+    shift, width = shift_width
+    return (word >> shift) & ((1 << width) - 1)
+
+
+def put_field(val, shift_width):
+    shift, width = shift_width
+    return (val & ((1 << width) - 1)) << shift
+
+
+def put_field_checked(val, shift_width, name="field"):
+    """Host-side fail-loud variant: a value outside the field width means
+    the state is un-representable under the configured bounds (possible if
+    a user disables the stock constraints) — fault, don't alias."""
+    shift, width = shift_width
+    if not 0 <= val < (1 << width):
+        raise OverflowError(
+            f"message {name}={val} exceeds {width}-bit packing; state is "
+            f"un-representable under the configured bounds")
+    return val << shift
+
+
+def pack_entry(lay: Layout, term, etype, payload):
+    vb = lay.value_bits
+    return (term << (1 + vb)) | (etype << vb) | payload
+
+
+def unpack_entry(lay: Layout, e):
+    vb = lay.value_bits
+    return e >> (1 + vb), (e >> vb) & 1, e & ((1 << vb) - 1)
+
+
+def entry_term(lay: Layout, e):
+    return e >> (1 + lay.value_bits)
+
+
+def entry_type(lay: Layout, e):
+    return (e >> lay.value_bits) & 1
+
+
+def entry_payload(lay: Layout, e):
+    return e & ((1 << lay.value_bits) - 1)
+
+
+def hash_salts(lay: Layout, n_words: int, stream: int = 0) -> np.ndarray:
+    """Deterministic per-position 64-bit salts for the fingerprint mix."""
+    rng = np.random.RandomState(0xC0FFEE + 7919 * stream)
+    lo = rng.randint(0, 1 << 32, size=n_words, dtype=np.uint64)
+    hi = rng.randint(0, 1 << 32, size=n_words, dtype=np.uint64)
+    return (hi << np.uint64(32)) | lo
