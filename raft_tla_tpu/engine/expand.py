@@ -1,0 +1,161 @@
+"""Frontier expansion: the Next-relation as one vmapped/jitted step.
+
+The action grid mirrors the ∃-quantification TLC performs (SURVEY §3.1):
+each *family* (RequestVote, Receive, …) is vmapped over its parameter grid
+(server pairs, values, bag slots) and over the frontier batch axis, then
+families concatenate into a [B, A] candidate block with validity masks.
+
+Family order follows the oracle's successor enumeration
+(models/raft.py successors(), itself mirroring raft.tla:909-943) so
+candidate streams are comparable; receive lanes are family-major
+(UpdateTerm block, CheckOldConfig-discard block, main-handler block).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import (NEXT_ASYNC, NEXT_ASYNC_CRASH, NEXT_DYNAMIC, NEXT_FULL,
+                      ModelConfig)
+from ..ops.codec import ALL_KEYS
+from ..ops.kernels import RaftKernels
+from ..ops.layout import Layout
+
+
+@dataclass
+class Family:
+    name: str
+    fn: Callable            # (sv, der, *params) -> (ok, sv2)
+    params: Tuple[np.ndarray, ...]   # one array per param, equal length
+    labeler: Callable        # (*param_values) -> str
+
+    @property
+    def n_lanes(self):
+        return len(self.params[0]) if self.params else 1
+
+
+def build_families(lay: Layout) -> List[Family]:
+    cfg = lay.cfg
+    kern = RaftKernels(lay)
+    S, K = lay.S, lay.K
+    fams: List[Family] = []
+
+    def grid(*ranges):
+        arrs = np.meshgrid(*[np.asarray(r, np.int32) for r in ranges],
+                           indexing="ij")
+        return tuple(a.ravel() for a in arrs)
+
+    ij = grid(range(S), range(S))
+    ij_ne = tuple(a[ij[0] != ij[1]] for a in ij)        # i != j lanes
+    iv = grid(range(S), list(cfg.values))
+    i_ = grid(range(S))
+    k_ = grid(range(K))
+
+    fams.append(Family(
+        "RequestVote", kern.request_vote, ij,
+        lambda i, j: f"RequestVote({i},{j})"))
+    fams.append(Family(
+        "BecomeLeader", kern.become_leader, i_,
+        lambda i: f"BecomeLeader({i})"))
+    fams.append(Family(
+        "ClientRequest", kern.client_request, iv,
+        lambda i, v: f"ClientRequest({i},{v})"))
+    fams.append(Family(
+        "AdvanceCommitIndex", kern.advance_commit_index, i_,
+        lambda i: f"AdvanceCommitIndex({i})"))
+    fams.append(Family(
+        "AppendEntries", kern.append_entries, ij_ne,
+        lambda i, j: f"AppendEntries({i},{j})"))
+    fams.append(Family(
+        "UpdateTerm", kern.update_term, k_,
+        lambda k: f"UpdateTerm[slot{k}]"))
+    fams.append(Family(
+        "CocDiscard", kern.coc_discard, k_,
+        lambda k: f"CocDiscard[slot{k}]"))
+    fams.append(Family(
+        "Receive", kern.receive_main, k_,
+        lambda k: f"Receive[slot{k}]"))
+    fams.append(Family(
+        "Timeout", kern.timeout, i_,
+        lambda i: f"Timeout({i})"))
+    if cfg.next_family in (NEXT_ASYNC_CRASH, NEXT_FULL, NEXT_DYNAMIC):
+        fams.append(Family(
+            "Restart", lambda sv, der, i: kern.restart(sv, i), i_,
+            lambda i: f"Restart({i})"))
+    if cfg.next_family in (NEXT_FULL, NEXT_DYNAMIC):
+        fams.append(Family(
+            "Duplicate", lambda sv, der, k: kern.duplicate_message(sv, k),
+            k_, lambda k: f"Duplicate[slot{k}]"))
+        fams.append(Family(
+            "Drop", lambda sv, der, k: kern.drop_message(sv, k),
+            k_, lambda k: f"Drop[slot{k}]"))
+    if cfg.next_family == NEXT_DYNAMIC:
+        fams.append(Family(
+            "AddNewServer", kern.add_new_server, ij,
+            lambda i, j: f"AddNewServer({i},{j})"))
+        fams.append(Family(
+            "DeleteServer", kern.delete_server, ij_ne,
+            lambda i, j: f"DeleteServer({i},{j})"))
+    return fams
+
+
+class Expander:
+    """Compiled expansion over a frontier batch."""
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+        self.lay = Layout(cfg)
+        self.kern = RaftKernels(self.lay)
+        self.families = build_families(self.lay)
+        self.n_lanes = sum(f.n_lanes for f in self.families)
+        self._expand = jax.jit(self._expand_impl)
+
+    def lane_labels(self) -> List[str]:
+        out = []
+        for f in self.families:
+            cols = [p for p in f.params]
+            for vals in zip(*cols):
+                out.append(f.labeler(*[int(v) for v in vals]))
+        return out
+
+    def _expand_impl(self, svb: Dict[str, jnp.ndarray]):
+        """[B, ...] frontier -> (ok [B, A], cand dict of [B, A, ...])."""
+        kern = self.kern
+
+        def one_state(sv):
+            der = kern.derived(sv)
+            oks, cands = [], []
+            for fam in self.families:
+                lane = jax.vmap(fam.fn,
+                                in_axes=(None, None) + (0,) * len(fam.params))
+                ok, sv2 = lane(sv, der,
+                               *[jnp.asarray(p) for p in fam.params])
+                oks.append(ok)
+                cands.append(sv2)
+            ok = jnp.concatenate([o.reshape(-1) for o in oks])
+            cand = {k: jnp.concatenate([c[k] for c in cands], axis=0)
+                    for k in ALL_KEYS}
+            return ok, cand
+
+        return jax.vmap(one_state)(svb)
+
+    def expand(self, svb):
+        return self._expand(svb)
+
+    # ---- test/debug path -------------------------------------------------
+    def expand_one(self, arrs: Dict[str, np.ndarray]):
+        """Single state -> [(label, sv2_arrays)] for enabled lanes."""
+        svb = {k: jnp.asarray(v)[None] for k, v in arrs.items()}
+        ok, cand = self.expand(svb)
+        ok = np.asarray(ok)[0]
+        labels = self.lane_labels()
+        out = []
+        for lane in np.nonzero(ok)[0]:
+            sv2 = {k: np.asarray(cand[k])[0, lane] for k in ALL_KEYS}
+            out.append((labels[lane], sv2))
+        return out

@@ -51,7 +51,7 @@ from typing import Dict, Tuple
 import numpy as np
 
 from ..config import (MT_AEREQ, MT_AERESP, MT_CATREQ, MT_CATRESP, MT_COC,
-                      MT_RVREQ, MT_RVRESP, ModelConfig, popcount)
+                      MT_RVREQ, MT_RVRESP, popcount)
 from ..models.raft import Hist, State
 from .layout import (Layout, MSG_FIELDS, get_field, pack_entry,
                      put_field_checked, unpack_entry)
@@ -146,7 +146,7 @@ def unpack_msg(lay: Layout, words) -> tuple:
 # Scenario features from an oracle history (mirrors what kernels maintain)
 # ---------------------------------------------------------------------------
 
-def features_from_hist(h: Hist, cfg: ModelConfig) -> np.ndarray:
+def features_from_hist(h: Hist) -> np.ndarray:
     feat = np.zeros(NFEAT, dtype=np.int32)
     feat[F_PREFIX_MASK] = -1
     bl2_seen = False
@@ -192,7 +192,6 @@ def features_from_hist(h: Hist, cfg: ModelConfig) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 def encode(lay: Layout, sv: State, h: Hist) -> Dict[str, np.ndarray]:
-    cfg = lay.cfg
     S, Lcap, K, MW = lay.S, lay.Lcap, lay.K, lay.msg_words
     out = {
         "ct": np.array(sv.ct, dtype=np.int32),
@@ -226,7 +225,7 @@ def encode(lay: Layout, sv: State, h: Hist) -> Dict[str, np.ndarray]:
     ctr[C_NTRIED], ctr[C_NMC] = h.ntried, h.nmc
     ctr[C_GLOBLEN] = len(h.glob)
     out["ctr"] = ctr
-    out["feat"] = features_from_hist(h, cfg)
+    out["feat"] = features_from_hist(h)
     return out
 
 

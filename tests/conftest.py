@@ -1,11 +1,20 @@
 """Test env: force JAX onto a virtual 8-device CPU mesh so multi-chip
 sharding paths compile+run without TPU hardware (the driver separately
-dry-runs the real multi-chip path via __graft_entry__.dryrun_multichip)."""
+dry-runs the real multi-chip path via __graft_entry__.dryrun_multichip).
+
+The axon TPU plugin in this image overrides ``JAX_PLATFORMS`` during its
+sitecustomize registration, so the env var alone is not enough —
+``jax.config.update`` after import is what actually selects CPU here.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -81,7 +81,7 @@ def test_feature_lanes_match_oracle_predicates():
     states.append((deep.violations[0].state, deep.violations[0].hist))
     n_commit = 0
     for sv, h in states:
-        feat = features_from_hist(h, cfg)
+        feat = features_from_hist(h)
         assert feat[C.F_COMMIT_SEEN] == int(
             any(r[0] == "CommitEntry" for r in h.glob))
         restarts = [k + 1 for k, r in enumerate(h.glob)
@@ -125,7 +125,7 @@ def test_membership_feature_lanes_match_oracle_predicates():
     samples.extend((sv0, h0._replace(glob=g)) for g in synth)
     seen_added = False
     for sv, h in samples:
-        feat = features_from_hist(h, cfg)
+        feat = features_from_hist(h)
         added = 0
         for r in h.glob:
             if r[0] == "AddServer":

@@ -1,0 +1,104 @@
+"""Differential tests: vmapped kernels vs the Python oracle.
+
+For a sample of oracle-reachable states, the engine's expansion must
+produce exactly the oracle's successor multiset — same states, same
+history counters, same feature lanes (SURVEY §7.2 L1 exit criterion).
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import (Bounds, ModelConfig, NEXT_DYNAMIC,
+                                 NEXT_FULL)
+from raft_tla_tpu.engine.expand import Expander
+from raft_tla_tpu.models.explore import explore
+from raft_tla_tpu.models.raft import successors
+from raft_tla_tpu.ops.codec import (C_GLOBLEN, C_NLEADERS, C_NMC, C_NREQ,
+                                    C_NTRIED, C_OVERFLOW, decode, encode,
+                                    features_from_hist)
+from raft_tla_tpu.ops.layout import Layout
+
+SMALL = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    bounds=Bounds.make(max_log_length=2, max_timeouts=2),
+    symmetry=False)
+
+UNRELIABLE = SMALL.with_(next_family=NEXT_FULL)
+
+MEMBER = ModelConfig(
+    n_servers=3, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_DYNAMIC,
+    bounds=Bounds.make(max_log_length=2, max_timeouts=2),
+    symmetry=False)
+
+
+def oracle_succ_multiset(sv, h, cfg):
+    out = Counter()
+    for _label, sv2, h2 in successors(sv, h, cfg):
+        key = (sv2, h2.restarted, h2.timeout, h2.nleaders, h2.nreq,
+               h2.ntried, h2.nmc, len(h2.glob),
+               tuple(features_from_hist(h2)))
+        out[key] += 1
+    return out
+
+
+def engine_succ_multiset(exp, lay, arrs, cfg):
+    out = Counter()
+    for _label, sv2arr in exp.expand_one(arrs):
+        assert int(sv2arr["ctr"][C_OVERFLOW]) == 0, "overflow fault"
+        sv2, h2 = decode(lay, sv2arr)
+        key = (sv2, h2.restarted, h2.timeout, h2.nleaders, h2.nreq,
+               h2.ntried, h2.nmc, int(sv2arr["ctr"][C_GLOBLEN]),
+               tuple(int(x) for x in sv2arr["feat"]))
+        out[key] += 1
+    return out
+
+
+def sample_states(cfg, n, extra_targets=()):
+    res = explore(cfg, max_states=4000, keep_states=True)
+    states = list(res.states.values())
+    rng = np.random.RandomState(42)
+    idx = rng.choice(len(states), size=min(n, len(states)), replace=False)
+    sample = [states[i] for i in idx]
+    # always include init and deep scenario witnesses (commit paths etc.)
+    sample.append(states[0])
+    for target in extra_targets:
+        deep = explore(cfg.with_(invariants=(target,)),
+                       stop_on_violation=True, max_states=200_000)
+        assert deep.violations, f"no witness for {target}"
+        sample.append((deep.violations[0].state, deep.violations[0].hist))
+    return sample
+
+
+def run_differential(cfg, n=120, extra_targets=()):
+    lay = Layout(cfg)
+    exp = Expander(cfg)
+    mismatches = []
+    for sv, h in sample_states(cfg, n, extra_targets):
+        want = oracle_succ_multiset(sv, h, cfg)
+        got = engine_succ_multiset(exp, lay, encode(lay, sv, h), cfg)
+        if want != got:
+            missing = want - got
+            spurious = got - want
+            mismatches.append((sv, h, missing, spurious))
+    assert not mismatches, (
+        f"{len(mismatches)} states mismatch; first: state="
+        f"{mismatches[0][0]}\nhist={mismatches[0][1]}\n"
+        f"missing={list(mismatches[0][2].items())[:3]}\n"
+        f"spurious={list(mismatches[0][3].items())[:3]}")
+
+
+def test_differential_async_crash():
+    run_differential(SMALL, extra_targets=("EntryCommitted",))
+
+
+def test_differential_unreliable():
+    run_differential(UNRELIABLE, n=80)
+
+
+def test_differential_membership():
+    run_differential(
+        MEMBER, n=60,
+        extra_targets=("AddSucessful", "MembershipChangeCommits"))
