@@ -1,0 +1,314 @@
+"""Level-synchronous BFS engine: TLC's worker loop, TPU-shaped.
+
+Replaces the reference's external checker (SURVEY §2.13: TLC's BFS +
+fingerprint set + invariant eval) with a two-phase device pipeline per
+frontier chunk:
+
+  phase 1 (jit):  expand the chunk over the action grid (engine/expand),
+                  evaluate ACTION_CONSTRAINTS against the parent, and
+                  fingerprint every candidate (engine/fingerprint)
+  host:           first-seen dedup in candidate order (stable — mirrors
+                  the oracle BFS ordering) against the visited set
+  phase 2 (jit):  on the *new* states only: invariant verdicts +
+                  CONSTRAINT masks (prune-expansion semantics, §2.8)
+
+The visited set is a sorted uint64 fingerprint array merged per level —
+the host-side analog of TLC's fingerprint set.  Parent pointers
+(state-id, lane-id) append per level for trace reconstruction
+(SURVEY §7.2 L5).  Multi-device sharding wraps phase 1 (parallel/).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import CANDIDATE, ModelConfig
+from ..models.raft import Hist, State, init_state
+from ..ops.codec import (ALL_KEYS, C_GLOBLEN, C_OVERFLOW, decode, encode)
+from ..ops.kernels import RaftKernels
+from ..ops.layout import Layout
+from ..ops.vpredicates import Predicates
+from .expand import Expander
+from .fingerprint import Fingerprinter, combine_u64
+
+
+def _cat(chunks: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
+
+
+def fp_key(fp_u32: np.ndarray) -> np.ndarray:
+    """[N, n_streams] u32 -> 1-D sortable dedup key covering ALL streams:
+    plain u64 for the 2-stream default, a lexicographic structured array
+    for fp128 (so the extra streams actually buy collision resistance)."""
+    u64 = combine_u64(fp_u32)                     # [N, n_streams//2]
+    if u64.shape[1] == 1:
+        return u64[:, 0]
+    dtype = np.dtype([(f"w{i}", "<u8") for i in range(u64.shape[1])])
+    return np.ascontiguousarray(u64).view(dtype)[:, 0]
+
+
+def sorted_member(sorted_arr: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    """Membership of keys in a sorted array via searchsorted (the host
+    analog of TLC's fingerprint-set probe)."""
+    idx = np.searchsorted(sorted_arr, keys)
+    idx = np.minimum(idx, max(len(sorted_arr) - 1, 0))
+    if len(sorted_arr) == 0:
+        return np.zeros(len(keys), bool)
+    return sorted_arr[idx] == keys
+
+
+def sorted_merge(sorted_arr: np.ndarray, new_keys: np.ndarray) -> np.ndarray:
+    """O(N+M) merge of new (unsorted, unique) keys into a sorted array."""
+    new_sorted = np.sort(new_keys)
+    pos = np.searchsorted(sorted_arr, new_sorted)
+    return np.insert(sorted_arr, pos, new_sorted)
+
+
+def _take(arrs: Dict[str, np.ndarray], idx) -> Dict[str, np.ndarray]:
+    return {k: v[idx] for k, v in arrs.items()}
+
+
+@dataclass
+class Violation:
+    invariant: str
+    state_id: int
+    state: Optional[State] = None
+    hist: Optional[Hist] = None
+    trace: Optional[List[str]] = None
+
+
+@dataclass
+class CheckResult:
+    distinct_states: int
+    generated_states: int
+    depth: int
+    violations: List[Violation] = field(default_factory=list)
+    level_sizes: List[int] = field(default_factory=list)
+    seconds: float = 0.0
+    overflow_faults: int = 0
+
+    @property
+    def states_per_sec(self):
+        return self.distinct_states / max(self.seconds, 1e-9)
+
+
+class Engine:
+    """One compiled checker instance per (ModelConfig, chunk size)."""
+
+    def __init__(self, cfg: ModelConfig, chunk: int = 512,
+                 store_states: bool = True):
+        self.cfg = cfg
+        self.chunk = chunk
+        self.store_states = store_states
+        self.lay = Layout(cfg)
+        self.kern = RaftKernels(self.lay)
+        self.expander = Expander(cfg)
+        self.fpr = Fingerprinter(cfg)
+        self.preds = Predicates(self.lay)
+        self.inv_names = list(cfg.invariants)
+        self.con_names = list(cfg.constraints)
+        self.act_names = list(cfg.action_constraints)
+        self.labels = self.expander.lane_labels()
+        self.A = self.expander.n_lanes
+        self._phase1 = jax.jit(self._phase1_impl)
+        self._phase2 = jax.jit(self._phase2_impl)
+
+    # ------------------------------------------------------------------
+
+    def _act_ok(self, parent_sv, cand_sv):
+        """ACTION_CONSTRAINTS (raft.tla:1207-1210): evaluated on the
+        (unprimed, primed) pair; violating transitions are not taken."""
+        ok = jnp.bool_(True)
+        for nm in self.act_names:
+            if nm == "CommitWhenConcurrentLeaders_action_constraint":
+                deep = parent_sv["ctr"][C_GLOBLEN] >= 20
+                no_cand = jnp.all(cand_sv["st"] != CANDIDATE)
+                ok = ok & (~deep | no_cand)
+            else:
+                raise KeyError(f"unknown action constraint {nm}")
+        return ok
+
+    def _phase1_impl(self, svb):
+        ok, cand = self.expander._expand_impl(svb)          # [B,A], [B,A,…]
+
+        def per_state(parent, cand_row, ok_row):
+            def per_lane(c, o):
+                fp = self.fpr.fingerprint(c)
+                act = self._act_ok(parent, c)
+                return fp, act
+            return jax.vmap(per_lane)(cand_row, ok_row)
+
+        fp, act = jax.vmap(per_state)(svb, cand, ok)
+        return ok & act, cand, fp
+
+    def _phase2_impl(self, svb):
+        def one(sv):
+            der = self.kern.derived(sv)
+            inv = jnp.stack([self.preds.invariant_fn(nm)(sv, der)
+                             for nm in self.inv_names]) \
+                if self.inv_names else jnp.ones((0,), bool)
+            con = jnp.bool_(True)
+            for nm in self.con_names:
+                con = con & self.preds.constraint_fn(nm)(sv, der)
+            return inv, con
+        return jax.vmap(one)(svb)
+
+    # ------------------------------------------------------------------
+
+    def _pad(self, arrs: Dict[str, np.ndarray], n: int):
+        cur = len(arrs["ct"])
+        if cur == n:
+            return arrs, np.ones(n, bool)
+        pad = n - cur
+        out = {k: np.concatenate([v, np.repeat(v[:1], pad, axis=0)])
+               for k, v in arrs.items()}
+        return out, np.concatenate([np.ones(cur, bool), np.zeros(pad, bool)])
+
+    def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
+              stop_on_violation: bool = False,
+              seed_states: Optional[List[Tuple[State, Hist]]] = None,
+              verbose: bool = False) -> CheckResult:
+        t0 = time.time()
+        lay = self.lay
+        init_list = (seed_states if seed_states is not None
+                     else [init_state(self.cfg)])
+        init_arrs = _cat([{k: v[None] for k, v in
+                           encode(lay, sv, h).items()}
+                          for sv, h in init_list])
+        # fingerprint + check the roots
+        rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
+        root_fp = fp_key(np.asarray(jax.vmap(self.fpr.fingerprint)(rootsb)))
+        _uniq, first_idx = np.unique(root_fp, return_index=True)
+        first_idx.sort()
+        roots = _take(init_arrs, first_idx)
+        n_roots = len(first_idx)
+
+        res = CheckResult(distinct_states=0, generated_states=n_roots,
+                          depth=0)
+        visited = np.sort(root_fp[first_idx])
+        self._states: List[Dict[str, np.ndarray]] = []
+        self._parents = [np.full(n_roots, -1, np.int64)]
+        self._lanes = [np.full(n_roots, -1, np.int32)]
+        n_states = 0
+
+        def admit(new_arrs):
+            """Check invariants/constraints on new distinct states;
+            returns (expandable subset, their global ids) — CONSTRAINT
+            semantics: violating states are checked but not expanded."""
+            nonlocal n_states
+            m = len(new_arrs["ct"])
+            res.distinct_states += m
+            padded, _valid = self._pad(
+                new_arrs, max(self.chunk, int(2 ** np.ceil(np.log2(m)))))
+            inv, con = self._phase2(
+                {k: jnp.asarray(v) for k, v in padded.items()})
+            inv = np.asarray(inv)[:m]
+            con = np.asarray(con)[:m]
+            res.overflow_faults += int(
+                (new_arrs["ctr"][:, C_OVERFLOW] > 0).sum())
+            for j, nm in enumerate(self.inv_names):
+                for s in np.nonzero(~inv[:, j])[0]:
+                    res.violations.append(Violation(nm, n_states + s))
+            if self.store_states:
+                self._states.append(new_arrs)
+            keep = np.nonzero(con)[0]
+            gids = n_states + keep
+            n_states += m
+            return _take(new_arrs, keep), gids
+
+        frontier, front_ids = admit(roots)
+        if stop_on_violation and res.violations:
+            res.seconds = time.time() - t0
+            res.depth = 0
+            return res
+
+        depth = 0
+        while len(frontier["ct"]) and depth < max_depth and \
+                res.distinct_states < max_states:
+            depth += 1
+            level_new: List[Dict[str, np.ndarray]] = []
+            level_parents: List[np.ndarray] = []
+            level_lanes: List[np.ndarray] = []
+            level_fps: List[np.ndarray] = []
+            level_seen = visited[:0]          # empty, same key dtype
+            n_front = len(frontier["ct"])
+            for base in range(0, n_front, self.chunk):
+                piece = _take(frontier, slice(base, base + self.chunk))
+                piece_ids = front_ids[base:base + self.chunk]
+                padded, valid_b = self._pad(piece, self.chunk)
+                ok, cand, fp = self._phase1(
+                    {k: jnp.asarray(v) for k, v in padded.items()})
+                okn = np.asarray(ok) & valid_b[:, None]          # [B, A]
+                keys = fp_key(
+                    np.asarray(fp).reshape(-1, self.fpr.n_streams))
+                flat_ok = okn.reshape(-1)
+                res.generated_states += int(flat_ok.sum())
+                cand_order = np.nonzero(flat_ok)[0]
+                # first occurrence in candidate order (mirrors the
+                # oracle's first-seen survivor rule, SURVEY §7.4 pt 5)
+                _u, first = np.unique(keys[cand_order], return_index=True)
+                first.sort()
+                sel = cand_order[first]
+                fps_sel = keys[sel]
+                fresh = ~sorted_member(visited, fps_sel) & \
+                    ~sorted_member(level_seen, fps_sel)
+                sel = sel[fresh]
+                if len(sel) == 0:
+                    continue
+                new_arrs = {
+                    k: np.asarray(v).reshape((-1,) + v.shape[2:])[sel]
+                    for k, v in cand.items()}
+                level_new.append(new_arrs)
+                level_fps.append(fps_sel[fresh])
+                level_seen = sorted_merge(level_seen, fps_sel[fresh])
+                level_parents.append(piece_ids[sel // self.A])
+                level_lanes.append((sel % self.A).astype(np.int32))
+            if not level_new:
+                res.level_sizes.append(0)
+                break
+            new_arrs = _cat(level_new)
+            new_fps = np.concatenate(level_fps)
+            self._parents.append(np.concatenate(level_parents))
+            self._lanes.append(np.concatenate(level_lanes))
+            frontier, front_ids = admit(new_arrs)
+            visited = sorted_merge(visited, new_fps)
+            res.level_sizes.append(len(new_fps))
+            if stop_on_violation and res.violations:
+                break
+            if verbose:
+                print(f"depth {depth}: +{len(new_fps)} states "
+                      f"(total {res.distinct_states}), "
+                      f"frontier {len(frontier['ct'])}")
+        res.depth = depth
+        res.seconds = time.time() - t0
+        return res
+
+    # ------------------------------------------------------------------
+
+    def get_state(self, gid: int) -> Tuple[State, Hist]:
+        assert self.store_states, "state store disabled"
+        off = 0
+        for blk in self._states:
+            n = len(blk["ct"])
+            if gid < off + n:
+                return decode(self.lay, _take(blk, gid - off))
+            off += n
+        raise IndexError(gid)
+
+    def trace(self, gid: int) -> List[Tuple[str, State]]:
+        parents = np.concatenate(self._parents)
+        lanes = np.concatenate(self._lanes)
+        chain = []
+        g = gid
+        while g >= 0:
+            lane = lanes[g]
+            label = self.labels[lane] if lane >= 0 else "Init"
+            chain.append((label, self.get_state(g)[0]))
+            g = parents[g]
+        return list(reversed(chain))

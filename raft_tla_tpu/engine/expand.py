@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import (NEXT_ASYNC, NEXT_ASYNC_CRASH, NEXT_DYNAMIC, NEXT_FULL,
+from ..config import (NEXT_ASYNC_CRASH, NEXT_DYNAMIC, NEXT_FULL,
                       ModelConfig)
 from ..ops.codec import ALL_KEYS
 from ..ops.kernels import RaftKernels

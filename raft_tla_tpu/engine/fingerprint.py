@@ -1,0 +1,175 @@
+"""Symmetry-aware state fingerprints (VIEW + SYMMETRY semantics).
+
+State identity follows the reference model's TLC declarations
+(tlc_membership/raft.cfg:29-30): the fingerprint covers only the 10
+semantic variables (``VIEW vars`` — history/features excluded, SURVEY
+§2.2) and is canonical under server relabeling (``SYMMETRY perms``,
+raft.tla:1281) by taking the minimum over the permutation group of a
+64-bit hash of the relabeled view:
+
+  fp(s) = min_{σ ∈ G} H(relabel(s, σ))
+
+G is the subgroup of Permutations(Server) fixing InitServer setwise —
+Permutations(Server) as the reference declares would be unsound when
+InitServer ⊊ Server (models/explore.py symmetry_perms is the oracle twin).
+
+H hashes positional fields with per-position salts and the message bag
+**commutatively** (Σ over slots of count · mix(slot)), so bag slot order
+— or a message split across slots — never affects identity and no
+canonical bag sort exists anywhere in the engine (ops/layout.py).
+
+64-bit fingerprints are two independent 32-bit murmur-finalizer streams
+(no jax x64 dependency); ``fp128`` doubles the streams (SURVEY §7.4
+hard part 4: TLC-style collision odds vs exhaustiveness claims).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import CONFIG_ENTRY, MT_COC, NIL, ModelConfig
+from ..models.explore import symmetry_perms
+from ..ops.kernels import RaftKernels
+from ..ops.layout import Layout
+
+U32 = jnp.uint32
+
+
+def fmix32(x):
+    """murmur3 finalizer on uint32 arrays (wrapping arithmetic)."""
+    x = x ^ (x >> 16)
+    x = x * U32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * U32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _salts(n: int, stream: int) -> np.ndarray:
+    rng = np.random.RandomState(0xC0FFEE + 7919 * stream)
+    return rng.randint(0, 1 << 32, size=n, dtype=np.uint32)
+
+
+class Fingerprinter:
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+        self.lay = Layout(cfg)
+        self.kern = RaftKernels(self.lay)
+        S, Lcap = self.lay.S, self.lay.Lcap
+        self.n_streams = 4 if cfg.fp128 else 2
+        # positional salt layout: ct,st,vf,ci,llen | log | vr,vg | ni,mi
+        self.n_pos = 5 * S + S * Lcap + 2 * S + 2 * S * S
+        self.pos_salts = [_salts(self.n_pos, t) for t in
+                          range(self.n_streams)]
+        self.bag_salts = [_salts(self.lay.msg_words + 1, 16 + t)
+                          for t in range(self.n_streams)]
+        if cfg.symmetry:
+            perms = symmetry_perms(cfg)
+        else:
+            perms = [tuple(range(S))]
+        self.sigmas = np.array(perms, dtype=np.int32)           # [P, S]
+        invs = np.zeros_like(self.sigmas)
+        for p, sig in enumerate(perms):
+            for i, t in enumerate(sig):
+                invs[p, t] = i
+        self.invs = invs
+
+    # ------------------------------------------------------------------
+
+    def _perm_mask(self, m, sigma):
+        out = jnp.zeros_like(m)
+        for i in range(self.lay.S):
+            out = out | (((m >> i) & 1) << sigma[i])
+        return out
+
+    def _perm_entry(self, e, sigma):
+        kern = self.kern
+        is_cfg = (kern.entry_type(e) == CONFIG_ENTRY) & (e != 0)
+        payload = kern.entry_payload(e)
+        permuted = kern.pack_entry(kern.entry_term(e), kern.entry_type(e),
+                                   self._perm_mask(payload, sigma))
+        return jnp.where(is_cfg, permuted, e)
+
+    def _relabel_view(self, sv: Dict, sigma, inv) -> List[jnp.ndarray]:
+        """Permuted VIEW as a flat list: positional arrays + (bag, cnt)."""
+        kern = self.kern
+        vf = sv["vf"][inv]
+        vf = jnp.where(vf >= 0, sigma[jnp.clip(vf, 0, self.lay.S - 1)], NIL)
+        log = self._perm_entry(sv["log"][inv], sigma)
+        positional = [
+            sv["ct"][inv], sv["st"][inv], vf, sv["ci"][inv],
+            sv["llen"][inv], log,
+            self._perm_mask(sv["vr"][inv], sigma),
+            self._perm_mask(sv["vg"][inv], sigma),
+            sv["ni"][inv][:, inv], sv["mi"][inv][:, inv],
+        ]
+
+        def perm_slot(words):
+            f = kern.msg_fields(words)
+            src = sigma[jnp.clip(f["msrc"], 0, self.lay.S - 1)]
+            dst = sigma[jnp.clip(f["mdst"], 0, self.lay.S - 1)]
+            b = jnp.where(
+                f["mtype"] == MT_COC,
+                sigma[jnp.clip(f["b"], 0, self.lay.S - 1)], f["b"])
+            ent = self._perm_entry(f["ent"], sigma)
+            empty = f["mtype"] == 0
+            repacked = kern.pack_msg(f["mtype"], f["mterm"], src, dst,
+                                     a=f["a"], b=b, c=f["c"], ent=ent,
+                                     entlen=f["entlen"])
+            return jnp.where(empty, words, repacked)
+
+        bag = jax.vmap(perm_slot)(sv["bag"])
+        return positional, bag
+
+    def _hash_streams(self, positional, bag, cnt) -> jnp.ndarray:
+        flat = jnp.concatenate(
+            [p.reshape(-1).astype(U32) for p in positional])
+        out = []
+        for t in range(self.n_streams):
+            h = jnp.sum(fmix32(flat ^ jnp.asarray(self.pos_salts[t])))
+            bs = jnp.asarray(self.bag_salts[t])
+            slot = jnp.zeros((bag.shape[0],), U32)
+            for w in range(self.lay.msg_words):
+                slot = slot + fmix32(bag[:, w] ^ bs[w])
+            h = h + jnp.sum(cnt.astype(U32) * fmix32(slot ^ bs[-1]))
+            out.append(h)
+        return jnp.stack(out)                        # [n_streams] u32
+
+    def fingerprint(self, sv: Dict) -> jnp.ndarray:
+        """Single state -> u32[n_streams], min over the symmetry group
+        (lexicographic order on the stream vector)."""
+
+        def one_perm(sigma, inv):
+            positional, bag = self._relabel_view(sv, sigma, inv)
+            return self._hash_streams(positional, bag, sv["cnt"])
+
+        hs = jax.vmap(one_perm)(jnp.asarray(self.sigmas),
+                                jnp.asarray(self.invs))   # [P, streams]
+        # lexicographic min over P via iterative select (P is small)
+        P = hs.shape[0]
+        best = hs[0]
+        for p in range(1, P):
+            cand = hs[p]
+            less = jnp.bool_(False)
+            eq = jnp.bool_(True)
+            for t in range(self.n_streams):
+                less = less | (eq & (cand[t] < best[t]))
+                eq = eq & (cand[t] == best[t])
+            best = jnp.where(less, cand, best)
+        return best
+
+    def fingerprint_batch(self, svb: Dict) -> jnp.ndarray:
+        return jax.vmap(self.fingerprint)(svb)       # [B, n_streams]
+
+
+def combine_u64(fp: np.ndarray) -> np.ndarray:
+    """Host side: [N, n_streams] u32 -> [N, n_streams//2] u64 words (or a
+    single u64 for the default 2-stream mode)."""
+    fp = np.asarray(fp, dtype=np.uint64)
+    hi = fp[:, 0::2]
+    lo = fp[:, 1::2]
+    return (hi << np.uint64(32)) | lo

@@ -42,6 +42,9 @@ the oracle history here for encoding mid-trace states):
                        no prefix pin is configured.  STUB for now: always -1;
                        wired up with the punctuated-search feature (the cfg
                        has no prefix-pin field yet)
+    F_MC_COMMITS       count of CommitMembershipChange records — feeds
+                       MembershipChangeCommits / MultipleMembership-
+                       ChangesCommit (raft.tla:1239-1246)
 """
 
 from __future__ import annotations
@@ -62,7 +65,7 @@ C_NLEADERS, C_NREQ, C_NTRIED, C_NMC, C_GLOBLEN, C_OVERFLOW = range(6)
 NFEAT = 12
 (F_COMMIT_SEEN, F_BL2_SEEN, F_CWCL_POS, F_LAST_RESTART_POS,
  F_MIN_RESTART_GAP, F_ADDED_SET, F_OPEN_ADD, F_NJBL, F_LCDCC,
- F_ADD_COMMITS, F_PREFIX_MASK, F_RESERVED) = range(NFEAT)
+ F_ADD_COMMITS, F_PREFIX_MASK, F_MC_COMMITS) = range(NFEAT)
 
 NO_GAP = 1 << 20  # "no restart pair yet" sentinel for F_MIN_RESTART_GAP
 
@@ -179,6 +182,7 @@ def features_from_hist(h: Hist) -> np.ndarray:
             if r[2] & added:
                 feat[F_ADD_COMMITS] = 1
             open_add = False
+            feat[F_MC_COMMITS] += 1
     feat[F_BL2_SEEN] = int(bl2_seen)
     feat[F_LAST_RESTART_POS] = last_restart
     feat[F_MIN_RESTART_GAP] = min_gap

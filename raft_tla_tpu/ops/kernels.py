@@ -32,7 +32,8 @@ from ..config import (CANDIDATE, CONFIG_ENTRY, FOLLOWER, LEADER, MT_AEREQ,
 from .codec import (C_GLOBLEN, C_NLEADERS, C_NMC, C_NREQ, C_NTRIED,
                     C_OVERFLOW, F_ADD_COMMITS, F_ADDED_SET, F_BL2_SEEN,
                     F_COMMIT_SEEN, F_CWCL_POS, F_LAST_RESTART_POS, F_LCDCC,
-                    F_MIN_RESTART_GAP, F_NJBL, F_OPEN_ADD, NO_GAP)
+                    F_MC_COMMITS, F_MIN_RESTART_GAP, F_NJBL, F_OPEN_ADD,
+                    NO_GAP)
 from . import layout as layout_mod
 from .layout import Layout, get_field, put_field
 
@@ -364,6 +365,7 @@ class RaftKernels:
         feat = feat.at[F_ADD_COMMITS].max(add_hit.astype(jnp.int32))
         feat = feat.at[F_OPEN_ADD].set(
             jnp.where(is_mc, 0, feat[F_OPEN_ADD]))
+        feat = feat.at[F_MC_COMMITS].add(is_mc.astype(jnp.int32))
         sv2["feat"] = feat
         sv2 = self._glob(sv2, did_commit.astype(jnp.int32))
         return ok, sv2

@@ -1,0 +1,373 @@
+"""Vectorized invariants, constraints, and scenario properties.
+
+Device twins of models/predicates.py (the oracle forms, which cite
+tlc_membership/raft.tla line-by-line).  Each predicate maps a single SoA
+state to a bool ("holds"); the engine vmaps them over batches of newly
+discovered states.  Quantifier structure becomes broadcasting:
+
+  * ∀ server pairs / log positions  -> [S, S, Lcap] masks + jnp.all
+  * ∃ quorum ⊆ config with property P -> the counting closed form
+    2·|config ∩ P| > |config| (no SUBSET enumeration; QuorumLogInv's
+    "every quorum contains a good server" dualizes to "the bad set
+    cannot itself contain a quorum")
+
+TLC semantics: CONSTRAINT = don't-expand (not reject), ACTION_CONSTRAINT
+= don't-generate (SURVEY §2.8); the engine applies them accordingly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+from ..config import (CANDIDATE, CONFIG_ENTRY, LEADER, MT_RVREQ, NIL,
+                      ModelConfig)
+from .codec import (C_GLOBLEN, C_NLEADERS, C_NMC, C_NREQ, C_NTRIED,
+                    F_ADD_COMMITS, F_ADDED_SET, F_COMMIT_SEEN, F_CWCL_POS,
+                    F_LCDCC, F_MC_COMMITS, F_MIN_RESTART_GAP, F_NJBL)
+from .kernels import RaftKernels, popcount
+from .layout import Layout, get_field
+
+
+class Predicates:
+    """Predicate family bound to one (Layout, ModelConfig)."""
+
+    def __init__(self, lay: Layout):
+        self.lay = lay
+        self.cfg = lay.cfg
+        self.kern = RaftKernels(lay)
+        self.S, self.Lcap = lay.S, lay.Lcap
+
+    # ------------------------------------------------------------------
+    # Shared derived quantities
+    # ------------------------------------------------------------------
+
+    def _prefix_ok(self, sv):
+        """prefix_ok[i, j] == IsPrefix(Committed(i), log[j])
+        (raft.tla:969, SequencesExt.tla:134-140).  commitIndex clamps to
+        the log length, mirroring the oracle's committed()."""
+        comm_len = jnp.minimum(sv["ci"], sv["llen"])          # [S]
+        eq = sv["log"][:, None, :] == sv["log"][None, :, :]   # [S, S, Lcap]
+        pos = jnp.arange(self.Lcap)
+        within = pos[None, None, :] < comm_len[:, None, None]
+        all_eq = jnp.all(eq | ~within, axis=2)
+        return all_eq & (comm_len[:, None] <= sv["llen"][None, :])
+
+    def _in_quorum(self, votes, config):
+        return self.kern.in_quorum(votes, config)
+
+    def _bits(self):
+        return jnp.int32(1) << jnp.arange(self.S)
+
+    # ------------------------------------------------------------------
+    # Safety invariants (raft.tla:988-1099; oracle: models/predicates.py)
+    # ------------------------------------------------------------------
+
+    def leader_votes_quorum(self, sv, der):
+        guard = sv["ctr"][C_NMC] != 0
+        ct, vf = sv["ct"], sv["vf"]
+        support = (ct[None, :] > ct[:, None]) | \
+            ((ct[None, :] == ct[:, None]) &
+             (vf[None, :] == jnp.arange(self.S)[:, None]))    # [i, j]
+        voters = jnp.sum(jnp.where(support, self._bits()[None, :], 0),
+                         axis=1)
+        ok = ~(sv["st"] == LEADER) | self._in_quorum(voters, der["config"])
+        return guard | jnp.all(ok)
+
+    def candidate_term_not_in_log(self, sv, der):
+        guard = sv["ctr"][C_NMC] != 0
+        ct, vf = sv["ct"], sv["vf"]
+        support = (ct[None, :] == ct[:, None]) & \
+            ((vf[None, :] == jnp.arange(self.S)[:, None]) |
+             (vf[None, :] == NIL))
+        voters = jnp.sum(jnp.where(support, self._bits()[None, :], 0),
+                         axis=1)
+        electable = (sv["st"] == CANDIDATE) & \
+            self._in_quorum(voters, der["config"])
+        terms = self.kern.entry_term(sv["log"])               # [S, Lcap]
+        occ = sv["log"] != 0
+        term_in_log = jnp.any(
+            occ[None, :, :] & (terms[None, :, :] == ct[:, None, None]),
+            axis=(1, 2))                                      # [i]
+        return guard | jnp.all(~electable | ~term_in_log)
+
+    def election_safety(self, sv, der):
+        terms = self.kern.entry_term(sv["log"])               # [S, Lcap]
+        occ = sv["log"] != 0
+        pos = jnp.arange(1, self.Lcap + 1)
+        # maxidx[i, j] = MaxOrZero index in log[j] with term currentTerm[i]
+        hit = occ[None, :, :] & \
+            (terms[None, :, :] == sv["ct"][:, None, None])
+        maxidx = jnp.max(jnp.where(hit, pos[None, None, :], 0), axis=2)
+        mine = jnp.diagonal(maxidx)                           # [i]
+        ok = ~(sv["st"] == LEADER)[:, None] | \
+            (maxidx <= mine[:, None])
+        return jnp.all(ok)
+
+    def log_matching(self, sv, der):
+        log = sv["log"]
+        terms = self.kern.entry_term(log)
+        pos = jnp.arange(self.Lcap)
+        within = (pos[None, None, :] < sv["llen"][:, None, None]) & \
+            (pos[None, None, :] < sv["llen"][None, :, None])
+        term_eq = (terms[:, None, :] == terms[None, :, :]) & within
+        entry_eq = log[:, None, :] == log[None, :, :]
+        prefix_eq = jnp.cumprod(entry_eq | ~within, axis=2).astype(bool)
+        return ~jnp.any(term_eq & ~prefix_eq)
+
+    def votes_granted_inv(self, sv, der):
+        """Corrected form (raft.tla:1048-1052)."""
+        pref = self._prefix_ok(sv)
+        vf = sv["vf"]
+        my_pref = jnp.take_along_axis(
+            pref, jnp.clip(vf, 0, self.S - 1)[:, None], axis=1)[:, 0]
+        return jnp.all((vf == NIL) | my_pref)
+
+    def votes_granted_inv_false(self, sv, der):
+        """Ricketts' original, documented-violated (raft.tla:1038-1046);
+        live in the apalache variant (SURVEY §2.7)."""
+        pref = self._prefix_ok(sv)                            # [j, i]
+        granted = ((sv["vg"][:, None] >> jnp.arange(self.S)[None, :])
+                   & 1) == 1                                  # [i, j]
+        same_term = sv["ct"][:, None] == sv["ct"][None, :]
+        need = granted & same_term
+        return ~jnp.any(need & ~pref.T)
+
+    def quorum_log_inv(self, sv, der):
+        """Every quorum has a member with my committed prefix — dual: the
+        bad set must not itself contain a quorum (raft.tla:1056-1060)."""
+        pref = self._prefix_ok(sv)                            # [i, j]
+        good = jnp.sum(jnp.where(pref, self._bits()[None, :], 0), axis=1)
+        bad = der["config"] & ~good
+        cfg_n = popcount(der["config"], self.S)
+        return jnp.all(~(2 * popcount(bad, self.S) > cfg_n))
+
+    def more_up_to_date_correct(self, sv, der):
+        lt = der["lastterm"]
+        more = (lt[:, None] > lt[None, :]) | \
+            ((lt[:, None] == lt[None, :]) &
+             (sv["llen"][:, None] >= sv["llen"][None, :]))    # [i, j]
+        pref = self._prefix_ok(sv)                            # [j, i]
+        return ~jnp.any(more & ~pref.T)
+
+    def leader_completeness(self, sv, der):
+        """Corrected form (raft.tla:1089-1099): a committed entry appears
+        at the same position in every higher-term current leader's log."""
+        log = sv["log"]
+        terms = self.kern.entry_term(log)
+        comm_len = jnp.minimum(sv["ci"], sv["llen"])
+        pos = jnp.arange(self.Lcap)
+        committed = pos[None, :] < comm_len[:, None]          # [i, k]
+        # [i, l, k]: leader l with ct[l] > entry term must hold the entry
+        higher = sv["ct"][None, :, None] > terms[:, None, :]
+        is_leader = (sv["st"] == LEADER)[None, :, None]
+        same = log[None, :, :] == log[:, None, :]             # [i, l, k]
+        within_l = pos[None, None, :] < sv["llen"][None, :, None]
+        ok = ~(committed[:, None, :] & is_leader & higher) | \
+            (within_l & same)
+        return jnp.all(ok)
+
+    def leader_completeness_false(self, sv, der):
+        """Original form, violated under concurrent leaders
+        (raft.tla:1079-1083); live in the apalache variant."""
+        pref = self._prefix_ok(sv)                            # [j, i]
+        is_leader = (sv["st"] == LEADER)[None, :]             # [j, i]
+        return ~jnp.any(is_leader & ~pref)
+
+    def one_at_a_time_membership_change_ok(self, sv, der):
+        """OURS (SURVEY preamble phantom-name warning): at most one
+        uncommitted ConfigEntry per log suffix."""
+        etypes = self.kern.entry_type(sv["log"])
+        occ = sv["log"] != 0
+        pos = jnp.arange(self.Lcap)
+        beyond = pos[None, :] >= sv["ci"][:, None]
+        n_uncommitted = jnp.sum(
+            (occ & (etypes == CONFIG_ENTRY) & beyond), axis=1)
+        return jnp.all(n_uncommitted <= 1)
+
+    # ------------------------------------------------------------------
+    # Scenario ("test case") properties (raft.tla:1143-1278) — negated
+    # reachability, read from counter/feature lanes
+    # ------------------------------------------------------------------
+
+    def bounded_trace(self, sv, der):
+        return sv["ctr"][C_GLOBLEN] <= 24
+
+    def first_become_leader(self, sv, der):
+        return sv["ctr"][C_NLEADERS] < 1
+
+    def first_commit(self, sv, der):
+        return jnp.all(sv["ci"] == 0)
+
+    def first_restart(self, sv, der):
+        return jnp.all(sv["restarted"] < 2)
+
+    def leadership_change(self, sv, der):
+        return sv["ctr"][C_NLEADERS] < 2
+
+    def membership_change(self, sv, der):
+        return sv["ctr"][C_NMC] < 1
+
+    def multiple_membership_changes(self, sv, der):
+        return sv["ctr"][C_NMC] < 2
+
+    def concurrent_leaders(self, sv, der):
+        return popcount(der["leaders"], self.S) < 2
+
+    def entry_committed(self, sv, der):
+        return sv["feat"][F_COMMIT_SEEN] == 0
+
+    def commit_when_concurrent_leaders(self, sv, der):
+        """raft.tla:1165-1176 via the F_CWCL_POS feature lane."""
+        two_now = popcount(der["leaders"], self.S) >= 2
+        p = sv["feat"][F_CWCL_POS]
+        witness = (p > 0) & (sv["ctr"][C_GLOBLEN] >= p + 2)
+        return ~(two_now & witness)
+
+    def majority_of_cluster_restarts(self, sv, der):
+        """raft.tla:1212-1226 via restart-position feature lanes."""
+        llen = sv["llen"]
+        nontrivial = jnp.any(
+            (llen[:, None] >= 2) & (llen[None, :] >= 1) &
+            (jnp.arange(self.S)[:, None] != jnp.arange(self.S)[None, :]))
+        restarted_set = jnp.sum(
+            jnp.where(sv["restarted"] >= 1, self._bits(), 0))
+        maj = 2 * popcount(restarted_set, self.S) > self.S
+        gaps_ok = sv["feat"][F_MIN_RESTART_GAP] >= 6
+        return ~(nontrivial & maj & gaps_ok)
+
+    def add_successful(self, sv, der):
+        return sv["feat"][F_ADDED_SET] == 0
+
+    def membership_change_commits(self, sv, der):
+        return sv["feat"][F_MC_COMMITS] < 1
+
+    def multiple_membership_changes_commit(self, sv, der):
+        return sv["feat"][F_MC_COMMITS] < 2
+
+    def add_commits(self, sv, der):
+        return sv["feat"][F_ADD_COMMITS] == 0
+
+    def newly_joined_become_leader(self, sv, der):
+        return sv["feat"][F_NJBL] == 0
+
+    def leader_changes_during_conf_change(self, sv, der):
+        return sv["feat"][F_LCDCC] == 0
+
+    # ------------------------------------------------------------------
+    # Constraints (raft.tla:1105-1137) — expansion gates
+    # ------------------------------------------------------------------
+
+    def bounded_in_flight_messages(self, sv, der):
+        return jnp.sum(sv["cnt"]) <= self.cfg.max_inflight
+
+    def bounded_request_vote(self, sv, der):
+        mtype = get_field(sv["bag"][:, 0],
+                          self.lay.header_shifts["mtype"]).astype(jnp.int32)
+        return jnp.all(~((mtype == MT_RVREQ) & (sv["cnt"] > 1)))
+
+    def bounded_log_size(self, sv, der):
+        return jnp.all(sv["llen"] <= self.cfg.bounds.max_log_length)
+
+    def bounded_restarts(self, sv, der):
+        return jnp.all(sv["restarted"] <= self.cfg.bounds.max_restarts)
+
+    def bounded_timeouts(self, sv, der):
+        return jnp.all(sv["timeout"] <= self.cfg.bounds.max_timeouts)
+
+    def bounded_terms(self, sv, der):
+        return jnp.all(sv["ct"] <= self.cfg.bounds.max_terms)
+
+    def bounded_client_requests(self, sv, der):
+        return sv["ctr"][C_NREQ] <= self.cfg.bounds.max_client_requests
+
+    def bounded_tried_membership_changes(self, sv, der):
+        return sv["ctr"][C_NTRIED] <= \
+            self.cfg.bounds.max_tried_membership_changes
+
+    def bounded_membership_changes(self, sv, der):
+        return sv["ctr"][C_NMC] <= self.cfg.bounds.max_membership_changes
+
+    def elections_uncontested(self, sv, der):
+        return jnp.sum((sv["st"] == CANDIDATE).astype(jnp.int32)) <= 1
+
+    def clean_start_until_first_request(self, sv, der):
+        pre = (sv["ctr"][C_NLEADERS] < 1) & (sv["ctr"][C_NREQ] < 1)
+        cond = jnp.all(sv["restarted"] == 0) & \
+            (jnp.sum(sv["timeout"]) <= 1) & \
+            (jnp.sum((sv["st"] == CANDIDATE).astype(jnp.int32)) <= 1)
+        return ~pre | cond
+
+    def clean_start_until_two_leaders(self, sv, der):
+        pre = sv["ctr"][C_NLEADERS] < 2
+        cond = (jnp.sum(sv["restarted"]) <= 1) & \
+            (jnp.sum(sv["timeout"]) <= 2)
+        return ~pre | cond
+
+    # ------------------------------------------------------------------
+    # Registries (cfg-name -> callable), mirroring models/predicates.py
+    # ------------------------------------------------------------------
+
+    def invariant_fn(self, name: str) -> Callable:
+        if self.cfg.apalache_variant and name in (
+                "VotesGrantedInv", "LeaderCompleteness"):
+            name = name + "_false"
+        return INVARIANTS[name].__get__(self)
+
+    def constraint_fn(self, name: str) -> Callable:
+        return CONSTRAINTS[name].__get__(self)
+
+
+INVARIANTS: Dict[str, Callable] = {
+    "LeaderVotesQuorum": Predicates.leader_votes_quorum,
+    "CandidateTermNotInLog": Predicates.candidate_term_not_in_log,
+    "ElectionSafety": Predicates.election_safety,
+    "LogMatching": Predicates.log_matching,
+    "VotesGrantedInv": Predicates.votes_granted_inv,
+    "VotesGrantedInv_false": Predicates.votes_granted_inv_false,
+    "QuorumLogInv": Predicates.quorum_log_inv,
+    "MoreUpToDateCorrect": Predicates.more_up_to_date_correct,
+    "LeaderCompleteness": Predicates.leader_completeness,
+    "LeaderCompleteness_false": Predicates.leader_completeness_false,
+    "OneAtATimeMembershipChangeOK":
+        Predicates.one_at_a_time_membership_change_ok,
+    "BoundedTrace": Predicates.bounded_trace,
+    "FirstBecomeLeader": Predicates.first_become_leader,
+    "FirstCommit": Predicates.first_commit,
+    "FirstRestart": Predicates.first_restart,
+    "LeadershipChange": Predicates.leadership_change,
+    "MembershipChange": Predicates.membership_change,
+    "MultipleMembershipChanges": Predicates.multiple_membership_changes,
+    "ConcurrentLeaders": Predicates.concurrent_leaders,
+    "EntryCommitted": Predicates.entry_committed,
+    "CommitWhenConcurrentLeaders":
+        Predicates.commit_when_concurrent_leaders,
+    "MajorityOfClusterRestarts": Predicates.majority_of_cluster_restarts,
+    "AddSucessful": Predicates.add_successful,
+    "MembershipChangeCommits": Predicates.membership_change_commits,
+    "MultipleMembershipChangesCommit":
+        Predicates.multiple_membership_changes_commit,
+    "AddCommits": Predicates.add_commits,
+    "NewlyJoinedBecomeLeader": Predicates.newly_joined_become_leader,
+    "LeaderChangesDuringConfChange":
+        Predicates.leader_changes_during_conf_change,
+}
+
+CONSTRAINTS: Dict[str, Callable] = {
+    "BoundedInFlightMessages": Predicates.bounded_in_flight_messages,
+    "BoundedRequestVote": Predicates.bounded_request_vote,
+    "BoundedLogSize": Predicates.bounded_log_size,
+    "BoundedRestarts": Predicates.bounded_restarts,
+    "BoundedTimeouts": Predicates.bounded_timeouts,
+    "BoundedTerms": Predicates.bounded_terms,
+    "BoundedClientRequests": Predicates.bounded_client_requests,
+    "BoundedTriedMembershipChanges":
+        Predicates.bounded_tried_membership_changes,
+    "BoundedMembershipChanges": Predicates.bounded_membership_changes,
+    "ElectionsUncontested": Predicates.elections_uncontested,
+    "CleanStartUntilFirstRequest":
+        Predicates.clean_start_until_first_request,
+    "CleanStartUntilTwoLeaders":
+        Predicates.clean_start_until_two_leaders,
+}

@@ -131,6 +131,8 @@ def test_membership_feature_lanes_match_oracle_predicates():
             if r[0] == "AddServer":
                 added |= 1 << r[2]
         assert feat[C.F_ADDED_SET] == added
+        assert feat[C.F_MC_COMMITS] == sum(
+            1 for r in h.glob if r[0] == "CommitMembershipChange")
         seen_added = seen_added or added != 0
         # feature-lane forms of the oracle predicates
         assert (feat[C.F_ADD_COMMITS] == 0) == P.add_commits(sv, h, cfg)

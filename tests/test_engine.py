@@ -1,0 +1,92 @@
+"""End-to-end engine differential tests vs the oracle BFS.
+
+Exit criterion from SURVEY §7.3: identical distinct-state counts and
+identical invariant verdicts on the same model, with and without
+symmetry reduction.
+"""
+
+from collections import Counter
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_DYNAMIC, NEXT_FULL
+from raft_tla_tpu.engine.bfs import Engine
+from raft_tla_tpu.models.explore import explore
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1),
+    symmetry=False)
+
+SMALL = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    bounds=Bounds.make(max_log_length=2, max_timeouts=2),
+    symmetry=False)
+
+MEMBER = ModelConfig(
+    n_servers=3, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_DYNAMIC, max_inflight_override=6,
+    bounds=Bounds.make(max_log_length=2, max_timeouts=1,
+                       max_client_requests=1, max_membership_changes=1),
+    symmetry=False)
+
+
+def compare(cfg, max_depth=10 ** 9, max_states=10 ** 9, **engine_kw):
+    want = explore(cfg, max_depth=max_depth, max_states=max_states)
+    eng = Engine(cfg, chunk=256, **engine_kw)
+    got = eng.check(max_depth=max_depth, max_states=max_states)
+    assert got.overflow_faults == 0
+    assert got.distinct_states == want.distinct_states, \
+        (got.distinct_states, want.distinct_states)
+    assert got.depth == want.depth, (got.depth, want.depth)
+    want_viol = Counter(v.invariant for v in want.violations)
+    got_viol = Counter(v.invariant for v in got.violations)
+    assert got_viol == want_viol, (got_viol, want_viol)
+    return eng, got
+
+
+@pytest.mark.parametrize("sym", [False, True], ids=["nosym", "sym"])
+def test_micro_exhaustive(sym):
+    compare(MICRO.with_(symmetry=sym))
+
+
+def test_micro_fp128():
+    """128-bit fingerprints (4 streams, structured dedup keys) must give
+    identical counts."""
+    compare(MICRO.with_(fp128=True))
+
+
+def test_small_bounded():
+    compare(SMALL, max_depth=6)
+
+
+def test_small_symmetric_exhaustive():
+    compare(SMALL.with_(symmetry=True), max_depth=8)
+
+
+def test_membership_bounded():
+    compare(MEMBER, max_depth=5)
+
+
+def test_unreliable_bounded():
+    compare(SMALL.with_(next_family=NEXT_FULL), max_depth=4)
+
+
+def test_violation_and_trace():
+    """Scenario property: engine finds the FirstCommit witness and can
+    reconstruct its trace (the 15-step election+replication chain)."""
+    cfg = MICRO.with_(invariants=("FirstCommit",), symmetry=True)
+    eng = Engine(cfg, chunk=256, store_states=True)
+    got = eng.check(stop_on_violation=True)
+    assert got.violations
+    v = got.violations[0]
+    sv, h = eng.get_state(v.state_id)
+    assert any(c > 0 for c in sv.ci)
+    chain = eng.trace(v.state_id)
+    assert chain[0][0] == "Init"
+    assert len(chain) == 16  # 15 actions + Init
+    # oracle agrees on the depth of the first witness
+    want = explore(cfg, stop_on_violation=True, trace_violations=True)
+    assert len(want.violations[0].trace) == 15
