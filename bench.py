@@ -1,0 +1,80 @@
+"""Headline benchmark: distinct states/sec on the BASELINE.md metric
+config (tlc_membership raft.cfg at Server=3, MaxTerm=3, MaxLogLen=3,
+ElectionSafety checked — BASELINE.json config #2).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "states/sec", "vs_baseline": N}
+
+``vs_baseline`` compares against the Python oracle BFS (the stand-in CPU
+implementation measured on this machine; the reference publishes no
+numbers — BASELINE.md).  Correctness gate: before timing, the engine is
+differentially checked against the oracle on a micro config; a mismatch
+zeroes the score (guards against accelerator-path miscompiles).
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    from raft_tla_tpu.cfg.parser import load_model
+    from raft_tla_tpu.config import Bounds
+    from raft_tla_tpu.engine.bfs import Engine
+    from raft_tla_tpu.models.explore import explore
+
+    # -- correctness gate (micro config, fast) --------------------------
+    micro = load_model("/root/reference/tlc_membership/raft.cfg",
+                       bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                                          max_client_requests=1))
+    micro = micro.with_(n_servers=2, init_servers=(0, 1), values=(1,),
+                        max_inflight_override=4)
+    eng_micro = Engine(micro, chunk=256, store_states=False)
+    got = eng_micro.check()
+    want = explore(micro)
+    gate_ok = (got.distinct_states == want.distinct_states and
+               got.depth == want.depth and
+               len(got.violations) == len(want.violations))
+
+    # -- metric config #2 ----------------------------------------------
+    # MaxTerm=3 <=> max_timeouts=2 (MaxTerms = MaxTimeouts+1, raft.tla:27)
+    cfg = load_model("/root/reference/tlc_membership/raft.cfg",
+                     bounds=Bounds.make(max_log_length=3, max_timeouts=2,
+                                        max_client_requests=3))
+    cfg = cfg.with_(invariants=("ElectionSafety",))
+
+    budget_states = int(float(sys.argv[1])) if len(sys.argv) > 1 else 150_000
+    eng = Engine(cfg, chunk=2048, store_states=False)
+    eng.check(max_depth=2)                      # warm the jit caches
+    t0 = time.time()
+    r = eng.check(max_states=budget_states)
+    secs = time.time() - t0
+    rate = r.distinct_states / max(secs, 1e-9)
+
+    # -- CPU baseline: Python oracle BFS on the same config -------------
+    t0 = time.time()
+    want_small = explore(cfg, max_states=4000)
+    base_secs = time.time() - t0
+    base_rate = want_small.distinct_states / max(base_secs, 1e-9)
+
+    out = {
+        "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
+        "value": round(rate if gate_ok else 0.0, 1),
+        "unit": "states/sec",
+        "vs_baseline": round((rate / base_rate) if gate_ok else 0.0, 2),
+        "detail": {
+            "distinct_states": int(r.distinct_states),
+            "depth": int(r.depth),
+            "seconds": round(secs, 2),
+            "violations": len(r.violations),
+            "overflow_faults": int(r.overflow_faults),
+            "baseline_oracle_states_per_sec": round(base_rate, 1),
+            "correctness_gate": bool(gate_ok),
+            "exhausted": bool(r.distinct_states < budget_states),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,227 @@
+"""Command-line front-end: ``check`` and ``trace`` (SURVEY §7.2 L5).
+
+Mirrors the two ways the reference drives TLC (SURVEY §3.1, §3.5):
+
+  check  — exhaustive bounded model check: BFS to fixpoint, report
+           distinct states / depth / states/sec and any invariant
+           violations (with traces).
+  trace  — scenario-trace generation: enable ONE negated-reachability
+           property (raft.cfg "Test cases", §2.9) and print the witness
+           trace TLC would emit as a "violation".
+
+Engine selection: --engine tpu (default; the JAX BFS) or --engine oracle
+(the plain-Python reference implementation, for cross-checking).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .cfg.parser import load_model
+from .config import Bounds
+
+
+def _honor_platform_env():
+    """The axon TPU plugin in this image overrides JAX_PLATFORMS during
+    its sitecustomize registration; re-assert the user's choice (see
+    tests/conftest.py for the same dance)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and plat != "axon":
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
+def _apply_overrides(cfg, args):
+    kw = {}
+    if args.servers is not None:
+        kw["n_servers"] = args.servers
+        init = args.init_servers if args.init_servers is not None \
+            else args.servers
+        kw["init_servers"] = tuple(range(init))
+    elif args.init_servers is not None:
+        kw["init_servers"] = tuple(range(args.init_servers))
+    if args.symmetry is not None:
+        kw["symmetry"] = args.symmetry
+    b = cfg.bounds
+    bkw = {}
+    if args.max_terms is not None:
+        bkw["max_terms"] = args.max_terms
+    if args.max_log_length is not None:
+        bkw["max_log_length"] = args.max_log_length
+    if args.max_timeouts is not None:
+        bkw["max_timeouts"] = args.max_timeouts
+    if args.max_client_requests is not None:
+        bkw["max_client_requests"] = args.max_client_requests
+    if bkw:
+        kw["bounds"] = Bounds.make(
+            max_log_length=bkw.get("max_log_length", b.max_log_length),
+            max_restarts=b.max_restarts,
+            max_timeouts=bkw.get("max_timeouts", b.max_timeouts),
+            max_client_requests=bkw.get("max_client_requests",
+                                        b.max_client_requests),
+            max_membership_changes=b.max_membership_changes,
+            max_terms=bkw.get("max_terms"),
+            max_trace=b.max_trace)
+    if args.fp128:
+        kw["fp128"] = True
+    return cfg.with_(**kw) if kw else cfg
+
+
+def _print_violation(idx, name, trace):
+    print(f"\nViolation {idx}: invariant {name}")
+    if trace:
+        for step, (label, sv) in enumerate(trace):
+            print(f"  {step:3d}  {label}")
+            print(f"       {sv}")
+
+
+def cmd_check(args):
+    cfg = load_model(args.cfg, bounds=None)
+    cfg = _apply_overrides(cfg, args)
+    if args.engine == "oracle":
+        from .models.explore import explore
+        import time
+        t0 = time.time()
+        r = explore(cfg, max_depth=args.max_depth,
+                    max_states=args.max_states,
+                    stop_on_violation=not args.keep_going,
+                    trace_violations=True)
+        secs = time.time() - t0
+        viol = [(v.invariant, v.trace) for v in r.violations]
+        distinct, depth, gen = r.distinct_states, r.depth, \
+            r.generated_states
+    else:
+        from .engine.bfs import Engine
+        eng = Engine(cfg, chunk=args.chunk,
+                     store_states=not args.no_store)
+        r = eng.check(max_depth=args.max_depth, max_states=args.max_states,
+                      stop_on_violation=not args.keep_going,
+                      verbose=args.verbose)
+        secs = r.seconds
+        viol = []
+        for v in r.violations[:args.max_violations]:
+            trace = (eng.trace(v.state_id)
+                     if not args.no_store else None)
+            viol.append((v.invariant, trace))
+        distinct, depth, gen = r.distinct_states, r.depth, \
+            r.generated_states
+        if r.overflow_faults:
+            print(f"FAULT: {r.overflow_faults} un-representable states "
+                  f"(bounds too small for the disabled-constraint space)",
+                  file=sys.stderr)
+    print(json.dumps({
+        "distinct_states": int(distinct),
+        "generated_states": int(gen),
+        "depth": int(depth),
+        "seconds": round(secs, 3),
+        "states_per_sec": round(distinct / max(secs, 1e-9), 1),
+        "violations": len(viol),
+    }))
+    for k, (name, trace) in enumerate(viol):
+        if args.engine == "oracle":
+            print(f"\nViolation {k}: {name}")
+            if trace:
+                print("  " + " -> ".join(trace))
+        else:
+            _print_violation(k, name, trace)
+    return 1 if viol else 0
+
+
+def cmd_trace(args):
+    from .models import predicates as OP
+    if args.target not in OP.INVARIANTS:
+        print(f"unknown scenario property {args.target!r}; known: "
+              f"{', '.join(sorted(OP.INVARIANTS))}", file=sys.stderr)
+        return 2
+    cfg = load_model(args.cfg, bounds=None)
+    cfg = _apply_overrides(cfg, args)
+    cfg = cfg.with_(invariants=(args.target,))
+    if args.engine == "oracle":
+        import time
+        from .models.explore import explore
+        t0 = time.time()
+        r = explore(cfg, max_depth=args.max_depth,
+                    max_states=args.max_states, stop_on_violation=True,
+                    trace_violations=True)
+        if not r.violations:
+            print(f"no witness found for {args.target} within bounds "
+                  f"({r.distinct_states} states, depth {r.depth})")
+            return 1
+        print(f"witness for {args.target} at depth {r.depth} "
+              f"({r.distinct_states} states explored, "
+              f"{time.time() - t0:.1f}s):")
+        for step, label in enumerate(r.violations[0].trace):
+            print(f"  {step + 1:3d}  {label}")
+        return 0
+    from .engine.bfs import Engine
+    eng = Engine(cfg, chunk=args.chunk, store_states=True)
+    r = eng.check(max_depth=args.max_depth, max_states=args.max_states,
+                  stop_on_violation=True, verbose=args.verbose)
+    if not r.violations:
+        print(f"no witness found for {args.target} within bounds "
+              f"({r.distinct_states} states, depth {r.depth})")
+        return 1
+    v = r.violations[0]
+    print(f"witness for {args.target} at depth {r.depth} "
+          f"({r.distinct_states} states explored, "
+          f"{r.seconds:.1f}s):")
+    for step, (label, sv) in enumerate(eng.trace(v.state_id)):
+        print(f"  {step:3d}  {label}")
+        if args.verbose:
+            print(f"       {sv}")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="raft_tla_tpu",
+        description="TPU-native explicit-state model checker for the "
+                    "Raft spec family")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("cfg", help="path to a TLC .cfg model file")
+        sp.add_argument("--engine", choices=("tpu", "oracle"),
+                        default="tpu")
+        sp.add_argument("--chunk", type=int, default=512)
+        sp.add_argument("--max-depth", type=int, default=10 ** 9)
+        sp.add_argument("--max-states", type=int, default=10 ** 9)
+        sp.add_argument("--servers", type=int, default=None,
+                        help="override |Server|")
+        sp.add_argument("--init-servers", type=int, default=None,
+                        help="override |InitServer| (first K servers)")
+        sp.add_argument("--symmetry", action=argparse.BooleanOptionalAction,
+                        default=None)
+        sp.add_argument("--max-terms", type=int, default=None)
+        sp.add_argument("--max-log-length", type=int, default=None)
+        sp.add_argument("--max-timeouts", type=int, default=None)
+        sp.add_argument("--max-client-requests", type=int, default=None)
+        sp.add_argument("--fp128", action="store_true")
+        sp.add_argument("--verbose", "-v", action="store_true")
+
+    pc = sub.add_parser("check", help="exhaustive bounded check")
+    common(pc)
+    pc.add_argument("--keep-going", action="store_true",
+                    help="do not stop at the first violation")
+    pc.add_argument("--no-store", action="store_true",
+                    help="do not retain states (no traces; less memory)")
+    pc.add_argument("--max-violations", type=int, default=5)
+    pc.set_defaults(fn=cmd_check)
+
+    pt = sub.add_parser("trace", help="generate a scenario witness trace")
+    common(pt)
+    pt.add_argument("--target", required=True,
+                    help="scenario property name (e.g. FirstCommit, "
+                         "ConcurrentLeaders, MembershipChangeCommits)")
+    pt.set_defaults(fn=cmd_trace)
+
+    args = p.parse_args(argv)
+    _honor_platform_env()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

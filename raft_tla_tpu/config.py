@@ -106,11 +106,14 @@ class Bounds:
     # MaxTimeouts + 1, MaxTriedMembershipChanges = MaxMembershipChanges + 1.
     max_terms: int = 4
     max_tried_membership_changes: int = 4
+    # BoundedTrace cap (raft.tla:1143: 24; apalache variant :776: 12)
+    max_trace: int = 24
 
     @staticmethod
     def make(max_log_length=5, max_restarts=2, max_timeouts=3,
              max_client_requests=3, max_membership_changes=3,
-             max_terms=None, max_tried_membership_changes=None) -> "Bounds":
+             max_terms=None, max_tried_membership_changes=None,
+             max_trace=24) -> "Bounds":
         return Bounds(
             max_log_length=max_log_length,
             max_restarts=max_restarts,
@@ -122,6 +125,7 @@ class Bounds:
                 max_membership_changes + 1
                 if max_tried_membership_changes is None
                 else max_tried_membership_changes),
+            max_trace=max_trace,
         )
 
 

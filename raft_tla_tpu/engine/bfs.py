@@ -30,7 +30,7 @@ import numpy as np
 
 from ..config import CANDIDATE, ModelConfig
 from ..models.raft import Hist, State, init_state
-from ..ops.codec import (ALL_KEYS, C_GLOBLEN, C_OVERFLOW, decode, encode)
+from ..ops.codec import C_GLOBLEN, C_OVERFLOW, decode, encode
 from ..ops.kernels import RaftKernels
 from ..ops.layout import Layout
 from ..ops.vpredicates import Predicates
@@ -214,7 +214,9 @@ class Engine:
                 (new_arrs["ctr"][:, C_OVERFLOW] > 0).sum())
             for j, nm in enumerate(self.inv_names):
                 for s in np.nonzero(~inv[:, j])[0]:
-                    res.violations.append(Violation(nm, n_states + s))
+                    vsv, vh = decode(self.lay, _take(new_arrs, s))
+                    res.violations.append(
+                        Violation(nm, n_states + s, state=vsv, hist=vh))
             if self.store_states:
                 self._states.append(new_arrs)
             keep = np.nonzero(con)[0]
@@ -278,7 +280,9 @@ class Engine:
             self._lanes.append(np.concatenate(level_lanes))
             frontier, front_ids = admit(new_arrs)
             visited = sorted_merge(visited, new_fps)
-            res.level_sizes.append(len(new_fps))
+            # expandable count, matching the oracle's level_sizes
+            # (models/explore.py appends len(nxt) post-constraint)
+            res.level_sizes.append(len(frontier["ct"]))
             if stop_on_violation and res.violations:
                 break
             if verbose:

@@ -25,7 +25,7 @@ hard part 4: TLC-style collision odds vs exhaustiveness claims).
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp

@@ -88,6 +88,15 @@ def clean_start_until_two_leaders(sv, h, cfg):
     return True
 
 
+def clean_first_leader_election(sv, h, cfg):
+    """CleanFirstLeaderElection (apalache_no_membership/raft.tla:766-770):
+    until the first leader, no restarts and at most one candidate."""
+    if h.nleaders < 1:
+        return (all(r == 0 for r in h.restarted) and
+                elections_uncontested(sv, h, cfg))
+    return True
+
+
 CONSTRAINTS: Dict[str, Callable] = {
     "BoundedInFlightMessages": bounded_in_flight_messages,
     "BoundedRequestVote": bounded_request_vote,
@@ -101,6 +110,7 @@ CONSTRAINTS: Dict[str, Callable] = {
     "ElectionsUncontested": elections_uncontested,
     "CleanStartUntilFirstRequest": clean_start_until_first_request,
     "CleanStartUntilTwoLeaders": clean_start_until_two_leaders,
+    "CleanFirstLeaderElection": clean_first_leader_election,
 }
 
 
@@ -288,7 +298,7 @@ def _current_leaders(sv):
 
 
 def bounded_trace(sv, h, cfg):
-    return len(h.glob) <= 24
+    return len(h.glob) <= cfg.bounds.max_trace
 
 
 def first_become_leader(sv, h, cfg):

@@ -225,11 +225,20 @@ class RaftKernels:
             & (((der["config"][i] >> i) & 1) == 1)
         sv2 = dict(sv)
         sv2["st"] = sv["st"].at[i].set(CANDIDATE)
-        sv2["ct"] = sv["ct"].at[i].add(1)
+        # term-width capacity guard: packing holds max_terms + 1 (the one
+        # unconstrained step past BoundedTerms); beyond that, fault AND
+        # clamp so the state stays representable (the sibling overflow
+        # guards' contract) — reachable only when BoundedTerms is disabled
+        # (e.g. the apalache variant cfg) with too small a Bounds.max_terms
+        cap = self.cfg.bounds.max_terms + 1
+        overflow = sv["ct"][i] + 1 > cap
+        sv2["ct"] = sv["ct"].at[i].set(
+            jnp.minimum(sv["ct"][i] + 1, cap))
         sv2["vf"] = sv["vf"].at[i].set(NIL)
         sv2["vr"] = sv["vr"].at[i].set(0)
         sv2["vg"] = sv["vg"].at[i].set(0)
         sv2["timeout"] = sv["timeout"].at[i].add(1)
+        sv2["ctr"] = sv2["ctr"].at[C_OVERFLOW].add(overflow.astype(jnp.int32))
         sv2 = self._glob(sv2, 1)
         return ok, sv2
 

@@ -192,7 +192,7 @@ class Predicates:
     # ------------------------------------------------------------------
 
     def bounded_trace(self, sv, der):
-        return sv["ctr"][C_GLOBLEN] <= 24
+        return sv["ctr"][C_GLOBLEN] <= self.cfg.bounds.max_trace
 
     def first_become_leader(self, sv, der):
         return sv["ctr"][C_NLEADERS] < 1
@@ -305,6 +305,13 @@ class Predicates:
             (jnp.sum(sv["timeout"]) <= 2)
         return ~pre | cond
 
+    def clean_first_leader_election(self, sv, der):
+        """apalache_no_membership/raft.tla:766-770."""
+        pre = sv["ctr"][C_NLEADERS] < 1
+        cond = jnp.all(sv["restarted"] == 0) & \
+            (jnp.sum((sv["st"] == CANDIDATE).astype(jnp.int32)) <= 1)
+        return ~pre | cond
+
     # ------------------------------------------------------------------
     # Registries (cfg-name -> callable), mirroring models/predicates.py
     # ------------------------------------------------------------------
@@ -370,4 +377,6 @@ CONSTRAINTS: Dict[str, Callable] = {
         Predicates.clean_start_until_first_request,
     "CleanStartUntilTwoLeaders":
         Predicates.clean_start_until_two_leaders,
+    "CleanFirstLeaderElection":
+        Predicates.clean_first_leader_election,
 }

@@ -1,0 +1,76 @@
+"""Multi-device frontier sharding (SURVEY §2.14).
+
+The reference's engine-level parallelism is TLC's multi-worker BFS over
+shared memory (`-workers 8`); the TPU-native counterpart is **data
+parallelism over the frontier axis**: the per-level candidate expansion
+(engine/bfs phase 1: expand + fingerprint) is compiled once over a
+1-D ``jax.sharding.Mesh`` with the batch axis sharded, so each device
+expands its slice of the frontier.  A ``jax.lax.all_gather`` over the
+mesh axis exchanges the per-device fingerprint blocks (the ICI ride that
+replaces TLC's shared fingerprint table) so every device — and the host
+after one transfer — sees the full candidate fingerprint set.
+
+Fingerprint-ownership partitioning (hash-prefix → device, all-to-all
+exchange, device-resident visited set) is the planned next step; the
+host-side sorted set remains the dedup authority for now (SURVEY §7.2
+L6 lands in stages).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..engine.bfs import Engine
+
+
+class ShardedEngine(Engine):
+    """Engine whose phase-1 (expand + fingerprint) runs sharded over a
+    device mesh.  chunk must be a multiple of the mesh size."""
+
+    def __init__(self, cfg: ModelConfig, devices=None, chunk: int = 512,
+                 store_states: bool = True):
+        devices = devices if devices is not None else jax.devices()
+        self.mesh = Mesh(np.array(devices), axis_names=("frontier",))
+        self.n_dev = len(devices)
+        assert chunk % self.n_dev == 0, \
+            f"chunk {chunk} not divisible by {self.n_dev} devices"
+        super().__init__(cfg, chunk=chunk, store_states=store_states)
+        shard = NamedSharding(self.mesh, P("frontier"))
+        self._shard = shard
+        self._phase1 = jax.jit(
+            self._phase1_sharded,
+            in_shardings=({k: shard for k in self._state_keys()},),
+            out_shardings=(shard, {k: shard for k in self._state_keys()},
+                           shard))
+
+    def _state_keys(self):
+        from ..ops.codec import ALL_KEYS
+        return ALL_KEYS
+
+    def _phase1_sharded(self, svb):
+        ok, cand, fp = self._phase1_impl(svb)
+        return ok, cand, fp
+
+    def device_fingerprint_gather(self, svb: Dict[str, jnp.ndarray]):
+        """The explicit-collective path: shard_map the expansion and
+        all_gather the fingerprint blocks over ICI, returning the
+        globally-assembled [B, A, streams] fingerprints.  Used by the
+        multi-chip dry run to prove the collective compiles + executes."""
+        from jax.experimental.shard_map import shard_map
+
+        def local(svb_local):
+            _ok, _cand, fp = self._phase1_impl(svb_local)
+            return jax.lax.all_gather(fp, "frontier", tiled=True)
+
+        fn = shard_map(
+            local, mesh=self.mesh,
+            in_specs=({k: P("frontier") for k in self._state_keys()},),
+            out_specs=P(None),
+            check_rep=False)
+        return fn(svb)

@@ -1,0 +1,87 @@
+"""cfg front-end tests: parse the actual reference model files."""
+
+import subprocess
+import sys
+import json
+import os
+
+import pytest
+
+from raft_tla_tpu.cfg.parser import load_model, read_bounds_from_spec
+from raft_tla_tpu.config import (NEXT_ASYNC_CRASH, NEXT_FULL)
+
+TLC_CFG = "/root/reference/tlc_membership/raft.cfg"
+APA_CFG = "/root/reference/apalache_no_membership/raft.cfg"
+
+
+def test_parse_tlc_membership():
+    cfg = load_model(TLC_CFG)
+    assert cfg.n_servers == 3
+    assert cfg.init_servers == (0, 1, 2)
+    assert cfg.values == (1, 2)
+    assert cfg.num_rounds == 1
+    assert cfg.next_family == NEXT_ASYNC_CRASH
+    assert cfg.symmetry is True
+    assert not cfg.apalache_variant
+    # the 12 enabled constraints and 8 enabled invariants (raft.cfg:37-87)
+    assert len(cfg.constraints) == 12
+    assert cfg.invariants == (
+        "LeaderVotesQuorum", "CandidateTermNotInLog", "ElectionSafety",
+        "LogMatching", "VotesGrantedInv", "QuorumLogInv",
+        "MoreUpToDateCorrect", "LeaderCompleteness")
+    # in-spec bounds lifted from raft.tla:22-30
+    b = cfg.bounds
+    assert (b.max_log_length, b.max_restarts, b.max_timeouts,
+            b.max_client_requests, b.max_terms,
+            b.max_membership_changes) == (5, 2, 3, 3, 4, 3)
+    assert b.max_trace == 24
+    assert cfg.max_inflight == 2 * 9  # 2 * S^2 (raft.tla:30)
+
+
+def test_parse_apalache_no_membership():
+    cfg = load_model(APA_CFG)
+    assert cfg.n_servers == 2
+    assert cfg.init_servers == (0, 1)
+    assert cfg.values == (1, 2, 3)
+    assert cfg.next_family == NEXT_FULL
+    assert cfg.symmetry is False
+    assert cfg.apalache_variant
+    assert "CleanFirstLeaderElection" in cfg.constraints
+    b = cfg.bounds
+    assert (b.max_log_length, b.max_restarts, b.max_timeouts) == (5, 2, 2)
+    assert b.max_trace == 12
+    assert cfg.max_inflight == 16  # (2*S)^2 (apalache raft.tla:22)
+
+
+def run_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "raft_tla_tpu"] + list(argv),
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=900)
+
+
+def test_cli_check_micro():
+    """End-to-end CLI on the real tlc cfg with micro bounds, both
+    engines must agree."""
+    common = [TLC_CFG, "--servers", "2", "--max-timeouts", "1",
+              "--max-log-length", "1", "--max-client-requests", "1",
+              "--max-depth", "12"]
+    outs = {}
+    for engine in ("tpu", "oracle"):
+        r = run_cli("check", *common, "--engine", engine)
+        assert r.returncode == 0, r.stderr
+        outs[engine] = json.loads(r.stdout.splitlines()[0])
+    assert outs["tpu"]["distinct_states"] == \
+        outs["oracle"]["distinct_states"]
+    assert outs["tpu"]["depth"] == outs["oracle"]["depth"]
+    assert outs["tpu"]["violations"] == outs["oracle"]["violations"] == 0
+
+
+def test_cli_trace_first_commit():
+    r = run_cli("trace", TLC_CFG, "--servers", "2", "--max-timeouts", "1",
+                "--max-log-length", "1", "--max-client-requests", "1",
+                "--target", "FirstCommit")
+    assert r.returncode == 0, r.stderr
+    assert "witness for FirstCommit" in r.stdout
+    assert "AdvanceCommitIndex" in r.stdout

@@ -57,8 +57,15 @@ def engine_succ_multiset(exp, lay, arrs, cfg):
 
 
 def sample_states(cfg, n, extra_targets=()):
+    """Sample EXPANDABLE reachable states: kernels only ever run on
+    constraint-satisfying frontier states (CONSTRAINT semantics gate
+    expansion, SURVEY §2.8), so constraint-violating states are out of
+    contract (e.g. the term-capacity clamp fires beyond max_terms+1)."""
+    from raft_tla_tpu.models import predicates as OP
     res = explore(cfg, max_states=4000, keep_states=True)
-    states = list(res.states.values())
+    states = [
+        (sv, h) for sv, h in res.states.values()
+        if all(OP.CONSTRAINTS[nm](sv, h, cfg) for nm in cfg.constraints)]
     rng = np.random.RandomState(42)
     idx = rng.choice(len(states), size=min(n, len(states)), replace=False)
     sample = [states[i] for i in idx]
@@ -68,7 +75,11 @@ def sample_states(cfg, n, extra_targets=()):
         deep = explore(cfg.with_(invariants=(target,)),
                        stop_on_violation=True, max_states=200_000)
         assert deep.violations, f"no witness for {target}"
-        sample.append((deep.violations[0].state, deep.violations[0].hist))
+        sv, h = deep.violations[0].state, deep.violations[0].hist
+        assert all(OP.CONSTRAINTS[nm](sv, h, cfg)
+                   for nm in cfg.constraints), \
+            f"witness for {target} is not expandable; pick another target"
+        sample.append((sv, h))
     return sample
 
 
