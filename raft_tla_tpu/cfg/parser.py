@@ -224,12 +224,13 @@ def load_model(cfg_path, variant: Optional[str] = None,
         if nm not in OP.INVARIANTS:
             raise CfgError(f"unknown invariant {nm!r}")
     for nm in raw["constraints"]:
-        if nm in ("CommitWhenConcurrentLeaders_constraint",
-                  "CommitWhenConcurrentLeaders_unique",
+        if nm in ("CommitWhenConcurrentLeaders_unique",
                   "MajorityOfClusterRestarts_constraint"):
             raise CfgError(
-                f"punctuated-search constraint {nm!r} is not implemented "
-                f"yet (use --seed-trace once available)")
+                f"{nm!r} pins the search to a hard-coded trace prefix "
+                f"embedded in the spec (raft.tla:1198-1234); the "
+                f"equivalent here is `check --seed-trace <file>` with a "
+                f"witness emitted by `trace --emit-seed`")
         if nm not in OP.CONSTRAINTS:
             raise CfgError(f"unknown constraint {nm!r}")
     for nm in raw["action_constraints"]:

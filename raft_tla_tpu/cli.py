@@ -78,9 +78,47 @@ def _print_violation(idx, name, trace):
             print(f"       {sv}")
 
 
+def _load_seeds(path):
+    """Seed-trace file -> list of seeds (punctuated search: BFS explores
+    only extensions of the pinned prefix, raft.tla:1198-1234).  Entries
+    carry the oracle state/hist plus the exact non-VIEW lanes when
+    emitted by the engine."""
+    import json as _json
+    from .models.raft import state_from_obj
+    with open(path) as fh:
+        data = _json.load(fh)
+    if isinstance(data, dict):
+        data = [data]
+    oracle_seeds, engine_seeds = [], []
+    for obj in data:
+        sv, h = state_from_obj(obj)
+        oracle_seeds.append((sv, h))
+        engine_seeds.append((sv, h, obj.get("nonview")))
+    return oracle_seeds, engine_seeds
+
+
+def _engine_seed_arrays(cfg, engine_seeds):
+    import numpy as np
+    from .ops.codec import encode
+    from .ops.layout import Layout
+    lay = Layout(cfg)
+    out = []
+    for sv, h, nonview in engine_seeds:
+        arrs = encode(lay, sv, h)
+        if nonview:
+            for k, v in nonview.items():
+                arrs[k] = np.asarray(v, dtype=arrs[k].dtype)
+        out.append(arrs)
+    return out
+
+
 def cmd_check(args):
     cfg = load_model(args.cfg, bounds=None)
     cfg = _apply_overrides(cfg, args)
+    oracle_seeds = engine_seeds = None
+    if args.seed_trace:
+        oracle_seeds, raw = _load_seeds(args.seed_trace)
+        engine_seeds = _engine_seed_arrays(cfg, raw)
     if args.engine == "oracle":
         from .models.explore import explore
         import time
@@ -88,7 +126,7 @@ def cmd_check(args):
         r = explore(cfg, max_depth=args.max_depth,
                     max_states=args.max_states,
                     stop_on_violation=not args.keep_going,
-                    trace_violations=True)
+                    trace_violations=True, seed_states=oracle_seeds)
         secs = time.time() - t0
         viol = [(v.invariant, v.trace) for v in r.violations]
         distinct, depth, gen = r.distinct_states, r.depth, \
@@ -99,7 +137,7 @@ def cmd_check(args):
                      store_states=not args.no_store)
         r = eng.check(max_depth=args.max_depth, max_states=args.max_states,
                       stop_on_violation=not args.keep_going,
-                      verbose=args.verbose)
+                      verbose=args.verbose, seed_states=engine_seeds)
         secs = r.seconds
         viol = []
         for v in r.violations[:args.max_violations]:

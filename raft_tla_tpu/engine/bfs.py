@@ -118,6 +118,13 @@ class Engine:
         self.A = self.expander.n_lanes
         self._phase1 = jax.jit(self._phase1_impl)
         self._phase2 = jax.jit(self._phase2_impl)
+        # fixed-size on-device row gather: only SELECTED candidates ever
+        # leave the device (transferring the full [B, A, ...] candidate
+        # block per chunk dominated wall time on the TPU tunnel)
+        self._gather = jax.jit(
+            lambda cand, idx: {
+                k: v.reshape((-1,) + v.shape[2:])[idx]
+                for k, v in cand.items()})
 
     # ------------------------------------------------------------------
 
@@ -172,15 +179,20 @@ class Engine:
 
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
-              seed_states: Optional[List[Tuple[State, Hist]]] = None,
+              seed_states: Optional[List] = None,
               verbose: bool = False) -> CheckResult:
+        """seed_states entries are (State, Hist) pairs or raw SoA dicts
+        (the latter preserve feature lanes exactly — engine-emitted
+        seeds; punctuated search, SURVEY §2.9)."""
         t0 = time.time()
         lay = self.lay
         init_list = (seed_states if seed_states is not None
                      else [init_state(self.cfg)])
-        init_arrs = _cat([{k: v[None] for k, v in
-                           encode(lay, sv, h).items()}
-                          for sv, h in init_list])
+        init_arrs = _cat([
+            {k: np.asarray(v)[None] for k, v in s.items()}
+            if isinstance(s, dict) else
+            {k: v[None] for k, v in encode(lay, *s).items()}
+            for s in init_list])
         # fingerprint + check the roots
         rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
         root_fp = fp_key(np.asarray(jax.vmap(self.fpr.fingerprint)(rootsb)))
@@ -200,16 +212,25 @@ class Engine:
         def admit(new_arrs):
             """Check invariants/constraints on new distinct states;
             returns (expandable subset, their global ids) — CONSTRAINT
-            semantics: violating states are checked but not expanded."""
+            semantics: violating states are checked but not expanded.
+            Runs phase 2 in fixed-size chunks so the jit compiles ONCE
+            (variable-size padding would recompile per level)."""
             nonlocal n_states
             m = len(new_arrs["ct"])
             res.distinct_states += m
-            padded, _valid = self._pad(
-                new_arrs, max(self.chunk, int(2 ** np.ceil(np.log2(m)))))
-            inv, con = self._phase2(
-                {k: jnp.asarray(v) for k, v in padded.items()})
-            inv = np.asarray(inv)[:m]
-            con = np.asarray(con)[:m]
+            inv_parts, con_parts = [], []
+            for base in range(0, m, self.chunk):
+                piece = _take(new_arrs, slice(base, base + self.chunk))
+                padded, _valid = self._pad(piece, self.chunk)
+                inv_p, con_p = self._phase2(
+                    {k: jnp.asarray(v) for k, v in padded.items()})
+                n_live = len(piece["ct"])
+                inv_parts.append(np.asarray(inv_p)[:n_live])
+                con_parts.append(np.asarray(con_p)[:n_live])
+            inv = np.concatenate(inv_parts) if inv_parts else \
+                np.ones((0, len(self.inv_names)), bool)
+            con = np.concatenate(con_parts) if con_parts else \
+                np.ones((0,), bool)
             res.overflow_faults += int(
                 (new_arrs["ctr"][:, C_OVERFLOW] > 0).sum())
             for j, nm in enumerate(self.inv_names):
@@ -263,9 +284,15 @@ class Engine:
                 sel = sel[fresh]
                 if len(sel) == 0:
                     continue
-                new_arrs = {
-                    k: np.asarray(v).reshape((-1,) + v.shape[2:])[sel]
-                    for k, v in cand.items()}
+                pieces = []
+                for b2 in range(0, len(sel), self.chunk):
+                    piece_sel = sel[b2:b2 + self.chunk]
+                    padded_sel = np.zeros(self.chunk, np.int32)
+                    padded_sel[:len(piece_sel)] = piece_sel
+                    g = self._gather(cand, jnp.asarray(padded_sel))
+                    pieces.append({k: np.asarray(v)[:len(piece_sel)]
+                                   for k, v in g.items()})
+                new_arrs = _cat(pieces)
                 level_new.append(new_arrs)
                 level_fps.append(fps_sel[fresh])
                 level_seen = sorted_merge(level_seen, fps_sel[fresh])
@@ -296,12 +323,15 @@ class Engine:
     # ------------------------------------------------------------------
 
     def get_state(self, gid: int) -> Tuple[State, Hist]:
+        return decode(self.lay, self.get_state_arrays(gid))
+
+    def get_state_arrays(self, gid: int) -> Dict[str, np.ndarray]:
         assert self.store_states, "state store disabled"
         off = 0
         for blk in self._states:
             n = len(blk["ct"])
             if gid < off + n:
-                return decode(self.lay, _take(blk, gid - off))
+                return _take(blk, gid - off)
             off += n
         raise IndexError(gid)
 

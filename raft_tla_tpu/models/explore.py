@@ -147,8 +147,11 @@ class ExploreResult:
 def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
             max_states: int = 10 ** 9, keep_states: bool = False,
             stop_on_violation: bool = False,
-            trace_violations: bool = False) -> ExploreResult:
-    """Level-synchronous BFS from Init (SURVEY §3.1)."""
+            trace_violations: bool = False,
+            seed_states=None) -> ExploreResult:
+    """Level-synchronous BFS from Init (SURVEY §3.1), or from
+    ``seed_states`` [(sv, h), ...] for punctuated search (the pinned-
+    prefix technique of raft.tla:1198-1234 as replay-then-explore)."""
     perms = symmetry_perms(cfg) if cfg.symmetry else None
     inv_fns = [(nm, predicates.resolve_invariant(nm, cfg))
                for nm in cfg.invariants]
@@ -161,11 +164,11 @@ def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
             sv = canonicalize(sv, perms, cfg)
         return sv
 
-    sv0, h0 = init_state(cfg)
-    k0 = key_of(sv0)
-    seen: Dict = {k0: (sv0, h0)}
-    parent: Dict = {k0: (None, None)}
-    result = ExploreResult(distinct_states=1, generated_states=1, depth=0)
+    roots = (seed_states if seed_states is not None
+             else [init_state(cfg)])
+    seen: Dict = {}
+    parent: Dict = {}
+    result = ExploreResult(distinct_states=0, generated_states=0, depth=0)
 
     def check(sv, h, k):
         for nm, fn in inv_fns:
@@ -178,11 +181,20 @@ def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
                     return False
         return True
 
-    if not check(sv0, h0, k0) and stop_on_violation:
-        result.states = seen if keep_states else None
-        return result
-
-    frontier = [(sv0, h0, k0)] if all(f(sv0, h0, cfg) for f in con_fns) else []
+    frontier = []
+    for sv0, h0 in roots:
+        k0 = key_of(sv0)
+        if k0 in seen:
+            continue
+        seen[k0] = (sv0, h0)
+        parent[k0] = (None, None)
+        result.generated_states += 1
+        if not check(sv0, h0, k0) and stop_on_violation:
+            result.distinct_states = len(seen)
+            result.states = seen if keep_states else None
+            return result
+        if all(f(sv0, h0, cfg) for f in con_fns):
+            frontier.append((sv0, h0, k0))
     depth = 0
     while frontier and depth < max_depth and len(seen) < max_states:
         depth += 1

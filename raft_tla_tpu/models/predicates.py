@@ -97,6 +97,18 @@ def clean_first_leader_election(sv, h, cfg):
     return True
 
 
+def commit_when_concurrent_leaders_constraint(sv, h, cfg):
+    """CommitWhenConcurrentLeaders_constraint (raft.tla:1182-1186) — the
+    WEAK punctuated-search pruning: by step >= 20 the history must
+    contain a BecomeLeader with >= 2 simultaneous leaders (the comment at
+    raft.tla:1188-1191 measures >1.2M length-20 traces still satisfy
+    this; the strong prefix pin is our --seed-trace mode instead)."""
+    if len(h.glob) < 20:
+        return True
+    return any(r[0] == "BecomeLeader" and popcount(r[2]) >= 2
+               for r in h.glob)
+
+
 CONSTRAINTS: Dict[str, Callable] = {
     "BoundedInFlightMessages": bounded_in_flight_messages,
     "BoundedRequestVote": bounded_request_vote,
@@ -111,6 +123,8 @@ CONSTRAINTS: Dict[str, Callable] = {
     "CleanStartUntilFirstRequest": clean_start_until_first_request,
     "CleanStartUntilTwoLeaders": clean_start_until_two_leaders,
     "CleanFirstLeaderElection": clean_first_leader_election,
+    "CommitWhenConcurrentLeaders_constraint":
+        commit_when_concurrent_leaders_constraint,
 }
 
 

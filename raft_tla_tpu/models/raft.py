@@ -122,6 +122,44 @@ def msg_term(m):
 
 
 # ---------------------------------------------------------------------------
+# JSON-able (de)serialization — the seed-trace file format for punctuated
+# search (`check --seed-trace`, the equivalent of the spec's hard-coded
+# prefix pins at raft.tla:1198-1234).
+# ---------------------------------------------------------------------------
+
+def _deep_tuple(x):
+    if isinstance(x, list):
+        return tuple(_deep_tuple(e) for e in x)
+    return x
+
+
+def _deep_list(x):
+    if isinstance(x, tuple):
+        return [_deep_list(e) for e in x]
+    return x
+
+
+def state_to_obj(sv: "State", h: "Hist") -> dict:
+    return {"state": [_deep_list(f)
+                      for f in (sv.ct, sv.st, sv.vf, sv.log, sv.ci, sv.vr,
+                                sv.vg, sv.ni, sv.mi, sv.msgs)],
+            "hist": [_deep_list(h.restarted), _deep_list(h.timeout),
+                     h.nleaders, h.nreq, h.ntried, h.nmc,
+                     _deep_list(h.glob)]}
+
+
+def state_from_obj(obj: dict):
+    f = [_deep_tuple(x) for x in obj["state"]]
+    sv = State(ct=f[0], st=f[1], vf=f[2], log=f[3], ci=f[4], vr=f[5],
+               vg=f[6], ni=f[7], mi=f[8], msgs=f[9])
+    hh = obj["hist"]
+    h = Hist(restarted=_deep_tuple(hh[0]), timeout=_deep_tuple(hh[1]),
+             nleaders=hh[2], nreq=hh[3], ntried=hh[4], nmc=hh[5],
+             glob=_deep_tuple(hh[6]))
+    return sv, h
+
+
+# ---------------------------------------------------------------------------
 # Small helpers
 # ---------------------------------------------------------------------------
 

@@ -24,8 +24,9 @@ import jax.numpy as jnp
 from ..config import (CANDIDATE, CONFIG_ENTRY, LEADER, MT_RVREQ, NIL,
                       ModelConfig)
 from .codec import (C_GLOBLEN, C_NLEADERS, C_NMC, C_NREQ, C_NTRIED,
-                    F_ADD_COMMITS, F_ADDED_SET, F_COMMIT_SEEN, F_CWCL_POS,
-                    F_LCDCC, F_MC_COMMITS, F_MIN_RESTART_GAP, F_NJBL)
+                    F_ADD_COMMITS, F_ADDED_SET, F_BL2_SEEN, F_COMMIT_SEEN,
+                    F_CWCL_POS, F_LCDCC, F_MC_COMMITS, F_MIN_RESTART_GAP,
+                    F_NJBL)
 from .kernels import RaftKernels, popcount
 from .layout import Layout, get_field
 
@@ -312,6 +313,11 @@ class Predicates:
             (jnp.sum((sv["st"] == CANDIDATE).astype(jnp.int32)) <= 1)
         return ~pre | cond
 
+    def commit_when_concurrent_leaders_constraint(self, sv, der):
+        """Weak punctuated-search pruning (raft.tla:1182-1186) via the
+        F_BL2_SEEN feature lane."""
+        return (sv["ctr"][C_GLOBLEN] < 20) | (sv["feat"][F_BL2_SEEN] == 1)
+
     # ------------------------------------------------------------------
     # Registries (cfg-name -> callable), mirroring models/predicates.py
     # ------------------------------------------------------------------
@@ -379,4 +385,6 @@ CONSTRAINTS: Dict[str, Callable] = {
         Predicates.clean_start_until_two_leaders,
     "CleanFirstLeaderElection":
         Predicates.clean_first_leader_election,
+    "CommitWhenConcurrentLeaders_constraint":
+        Predicates.commit_when_concurrent_leaders_constraint,
 }
