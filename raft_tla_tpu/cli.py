@@ -55,10 +55,12 @@ def _apply_overrides(cfg, args):
         bkw["max_timeouts"] = args.max_timeouts
     if args.max_client_requests is not None:
         bkw["max_client_requests"] = args.max_client_requests
+    if args.max_restarts is not None:
+        bkw["max_restarts"] = args.max_restarts
     if bkw:
         kw["bounds"] = Bounds.make(
             max_log_length=bkw.get("max_log_length", b.max_log_length),
-            max_restarts=b.max_restarts,
+            max_restarts=bkw.get("max_restarts", b.max_restarts),
             max_timeouts=bkw.get("max_timeouts", b.max_timeouts),
             max_client_requests=bkw.get("max_client_requests",
                                         b.max_client_requests),
@@ -67,6 +69,29 @@ def _apply_overrides(cfg, args):
             max_trace=b.max_trace)
     if args.fp128:
         kw["fp128"] = True
+    # cfg-surgery equivalents of TLC's comment-toggling (raft.cfg:51-76).
+    # ADDITIVE, like TLC's repeated CONSTRAINTS/INVARIANTS blocks: the
+    # cfg's general bounding constraints stay in force.
+    from .models import predicates as OP
+
+    def _add(base, extra, known, what):
+        for nm in extra:
+            if nm not in known:
+                raise SystemExit(
+                    f"unknown {what} {nm!r}; known: "
+                    f"{', '.join(sorted(known))}")
+        return tuple(dict.fromkeys(base + tuple(extra)))
+    if getattr(args, "invariants", None):
+        kw["invariants"] = _add(cfg.invariants, args.invariants,
+                                OP.INVARIANTS, "invariant")
+    if getattr(args, "constraint_overrides", None):
+        kw["constraints"] = _add(cfg.constraints, args.constraint_overrides,
+                                 OP.CONSTRAINTS, "constraint")
+    if getattr(args, "action_constraints", None):
+        kw["action_constraints"] = _add(cfg.action_constraints,
+                                        args.action_constraints,
+                                        OP.ACTION_CONSTRAINTS,
+                                        "action constraint")
     return cfg.with_(**kw) if kw else cfg
 
 
@@ -118,7 +143,24 @@ def cmd_check(args):
     oracle_seeds = engine_seeds = None
     if args.seed_trace:
         oracle_seeds, raw = _load_seeds(args.seed_trace)
-        engine_seeds = _engine_seed_arrays(cfg, raw)
+        if args.engine == "oracle":
+            # engine-emitted seeds (nonview lanes, no glob records)
+            # cannot feed the oracle's record-scanning predicates: they
+            # would silently evaluate against an empty history.
+            from .models.predicates import GLOB_DEPENDENT
+            needs_glob = GLOB_DEPENDENT & (
+                set(cfg.invariants) | set(cfg.constraints) |
+                set(cfg.action_constraints))
+            for _sv, h, nonview in raw:
+                if nonview and not h.glob and needs_glob:
+                    print(f"seed was emitted by the tpu engine (nonview "
+                          f"lanes, no history records); the oracle "
+                          f"cannot evaluate {sorted(needs_glob)} on it — "
+                          f"re-emit the seed with `trace --engine oracle "
+                          f"--emit-seed`", file=sys.stderr)
+                    return 2
+        else:
+            engine_seeds = _engine_seed_arrays(cfg, raw)
     if args.engine == "oracle":
         from .models.explore import explore
         import time
@@ -168,6 +210,12 @@ def cmd_check(args):
     return 1 if viol else 0
 
 
+def _write_seed(path, obj):
+    with open(path, "w") as fh:
+        json.dump(obj, fh)
+    print(f"seed written to {path}", file=sys.stderr)
+
+
 def cmd_trace(args):
     from .models import predicates as OP
     if args.target not in OP.INVARIANTS:
@@ -193,6 +241,10 @@ def cmd_trace(args):
               f"{time.time() - t0:.1f}s):")
         for step, label in enumerate(r.violations[0].trace):
             print(f"  {step + 1:3d}  {label}")
+        if args.emit_seed:
+            from .models.raft import state_to_obj
+            v = r.violations[0]
+            _write_seed(args.emit_seed, state_to_obj(v.state, v.hist))
         return 0
     from .engine.bfs import Engine
     eng = Engine(cfg, chunk=args.chunk, store_states=True)
@@ -210,6 +262,19 @@ def cmd_trace(args):
         print(f"  {step:3d}  {label}")
         if args.verbose:
             print(f"       {sv}")
+    if args.emit_seed:
+        import numpy as np
+        from .models.raft import state_to_obj
+        from .ops.codec import NONVIEW_KEYS, decode
+        arrs = eng.get_state_arrays(v.state_id)
+        sv, h = decode(eng.lay, arrs)
+        obj = state_to_obj(sv, h)
+        # raw non-VIEW lanes: exact history counters + scenario feature
+        # lanes, so a seeded engine resumes with identical constraint /
+        # scenario-predicate inputs (the decoded Hist has no glob)
+        obj["nonview"] = {k: np.asarray(arrs[k]).tolist()
+                          for k in NONVIEW_KEYS}
+        _write_seed(args.emit_seed, obj)
     return 0
 
 
@@ -237,6 +302,7 @@ def main(argv=None):
         sp.add_argument("--max-log-length", type=int, default=None)
         sp.add_argument("--max-timeouts", type=int, default=None)
         sp.add_argument("--max-client-requests", type=int, default=None)
+        sp.add_argument("--max-restarts", type=int, default=None)
         sp.add_argument("--fp128", action="store_true")
         sp.add_argument("--verbose", "-v", action="store_true")
 
@@ -247,6 +313,24 @@ def main(argv=None):
     pc.add_argument("--no-store", action="store_true",
                     help="do not retain states (no traces; less memory)")
     pc.add_argument("--max-violations", type=int, default=5)
+    pc.add_argument("--seed-trace", default=None, metavar="FILE",
+                    help="punctuated search: explore only extensions of "
+                         "the seed state(s) in FILE (emitted by `trace "
+                         "--emit-seed`; the engine analog of the spec's "
+                         "hard-coded prefix pins, raft.tla:1198-1234)")
+    # cfg toggles, check-only (trace derives its invariant from --target):
+    # ADD to the cfg's lists, mirroring TLC's additive repeated blocks
+    pc.add_argument("--invariant", dest="invariants",
+                    action="append", default=None, metavar="NAME",
+                    help="enable an extra invariant (repeatable) — the "
+                         "CLI analog of uncommenting the cfg's "
+                         "Test-cases block")
+    pc.add_argument("--constraint", dest="constraint_overrides",
+                    action="append", default=None, metavar="NAME",
+                    help="enable an extra CONSTRAINT (repeatable)")
+    pc.add_argument("--action-constraint", dest="action_constraints",
+                    action="append", default=None, metavar="NAME",
+                    help="enable an extra ACTION_CONSTRAINT (repeatable)")
     pc.set_defaults(fn=cmd_check)
 
     pt = sub.add_parser("trace", help="generate a scenario witness trace")
@@ -254,6 +338,9 @@ def main(argv=None):
     pt.add_argument("--target", required=True,
                     help="scenario property name (e.g. FirstCommit, "
                          "ConcurrentLeaders, MembershipChangeCommits)")
+    pt.add_argument("--emit-seed", default=None, metavar="FILE",
+                    help="write the witness end state to FILE as a seed "
+                         "for `check --seed-trace` (punctuated search)")
     pt.set_defaults(fn=cmd_trace)
 
     args = p.parse_args(argv)

@@ -494,3 +494,19 @@ ACTION_CONSTRAINTS: Dict[str, Callable] = {
     "CommitWhenConcurrentLeaders_action_constraint":
         commit_when_concurrent_leaders_action_constraint,
 }
+
+
+# Properties whose oracle evaluation scans the glob *record sequence*
+# (not just the counters).  A seed emitted by the tpu engine carries no
+# records (decode reconstructs counters only, ops/codec.py), so the
+# oracle cannot evaluate these faithfully on such a seed — the CLI
+# refuses that combination (cli.py cmd_check).
+GLOB_DEPENDENT = frozenset({
+    "BoundedTrace", "FirstBecomeLeader", "EntryCommitted",
+    "CommitWhenConcurrentLeaders", "MajorityOfClusterRestarts",
+    "AddSucessful", "MembershipChangeCommits",
+    "MultipleMembershipChangesCommit", "AddCommits",
+    "NewlyJoinedBecomeLeader", "LeaderChangesDuringConfChange",
+    "CommitWhenConcurrentLeaders_constraint",
+    "CommitWhenConcurrentLeaders_action_constraint",
+})

@@ -1,0 +1,155 @@
+"""Golden-trace fixtures replayed through oracle AND engine, plus the
+punctuated-search CLI flow end-to-end (seed emit -> seeded check).
+
+Mirrors the reference's signature technique: pin the search to a known
+witness prefix and explore only its extensions
+(tlc_membership/raft.tla:1188-1234, "punctuated search").
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from raft_tla_tpu.config import LEADER, ModelConfig, NEXT_ASYNC
+from raft_tla_tpu.models.raft import init_state, state_to_obj, successors
+from raft_tla_tpu.models import predicates
+
+from golden import (CONCURRENT_LEADERS_LABELS, CWCL_EXTENSION_LABELS,
+                    GOLDEN_20_KINDS, GOLDEN_28_KINDS)
+
+CFG3 = ModelConfig(n_servers=3, init_servers=(0, 1, 2), values=(1, 2),
+                   next_family=NEXT_ASYNC)
+TLC_CFG = "/root/reference/tlc_membership/raft.cfg"
+
+
+def apply_label(sv, h, cfg, label):
+    matches = [(s2, h2) for l, s2, h2 in successors(sv, h, cfg)
+               if l == label]
+    assert matches, f"no successor labelled {label}"
+    assert len(matches) == 1, f"ambiguous label {label}"
+    return matches[0]
+
+
+def replay(labels, cfg=CFG3, start=None):
+    sv, h = start if start is not None else init_state(cfg)
+    states = [(sv, h)]
+    for lbl in labels:
+        sv, h = apply_label(sv, h, cfg, lbl)
+        states.append((sv, h))
+    return states
+
+
+def test_golden_concurrent_leaders_oracle():
+    """Replaying the 20-record ConcurrentLeaders witness
+    (raft.tla:1201) reaches exactly the documented end state."""
+    sv, h = replay(CONCURRENT_LEADERS_LABELS)[-1]
+    assert [r[0] for r in h.glob] == GOLDEN_20_KINDS
+    # golden trailer: hadNumLeaders=2, timeouts s1=1 s2=1 s3=0,
+    # no restarts, no client requests (raft.tla:1201)
+    assert h.nleaders == 2 and h.nreq == 0
+    assert h.timeout == (1, 1, 0) and h.restarted == (0, 0, 0)
+    assert sv.st[0] == LEADER and sv.st[1] == LEADER
+    assert sv.ct == (2, 3, 3)
+    # ConcurrentLeaders scenario property fires here (raft.tla:1158)
+    assert not predicates.INVARIANTS["ConcurrentLeaders"](sv, h, CFG3)
+
+
+def test_golden_cwcl_oracle():
+    """The 28-record CommitWhenConcurrentLeaders witness
+    (raft.tla:1231): a commit lands while two leaders coexist."""
+    sv, h = replay(CONCURRENT_LEADERS_LABELS + CWCL_EXTENSION_LABELS)[-1]
+    assert [r[0] for r in h.glob] == GOLDEN_28_KINDS
+    assert h.nreq == 2 and h.nleaders == 2
+    # CommitEntry at record 26, trace runs 2 further records, and both
+    # leaders still stand (raft.tla:1165-1176)
+    assert h.glob[25][0] == "CommitEntry"
+    assert sv.st[0] == LEADER and sv.st[1] == LEADER
+    assert sv.ci == (0, 1, 0)
+    assert not predicates.INVARIANTS["CommitWhenConcurrentLeaders"](
+        sv, h, CFG3)
+
+
+def test_golden_engine_replay():
+    """Every golden step is reproduced by the device expansion: the
+    child's fingerprint appears among the parent's enabled candidates,
+    and the engine's scenario predicate fires on the end state."""
+    import jax
+    from raft_tla_tpu.engine.bfs import Engine, fp_key
+    from raft_tla_tpu.ops.codec import encode
+
+    cfg = CFG3.with_(symmetry=False)
+    eng = Engine(cfg, chunk=1, store_states=False)
+    states = replay(CONCURRENT_LEADERS_LABELS + CWCL_EXTENSION_LABELS,
+                    cfg=cfg)
+    enc = [encode(eng.lay, sv, h) for sv, h in states]
+    fp1 = jax.jit(eng.fpr.fingerprint)
+    for step, (parent, child) in enumerate(zip(enc, enc[1:])):
+        svb = {k: np.asarray(v)[None] for k, v in parent.items()}
+        ok, _cand, fp = eng._phase1(svb)
+        keys = fp_key(np.asarray(fp).reshape(-1, eng.fpr.n_streams))
+        child_key = fp_key(np.asarray(fp1(
+            {k: np.asarray(v) for k, v in child.items()}))[None])[0]
+        hit = (keys == child_key) & np.asarray(ok).reshape(-1)
+        label = (CONCURRENT_LEADERS_LABELS + CWCL_EXTENSION_LABELS)[step]
+        assert hit.any(), f"step {step} ({label}) not among candidates"
+    # end state: engine-side CommitWhenConcurrentLeaders verdict
+    final = {k: np.asarray(v)[None] for k, v in enc[-1].items()}
+    eng2 = Engine(cfg.with_(
+        invariants=("CommitWhenConcurrentLeaders",)), chunk=1,
+        store_states=False)
+    inv, _con = eng2._phase2({k: np.asarray(v) for k, v in final.items()})
+    assert not bool(np.asarray(inv)[0, 0]), \
+        "engine must report CommitWhenConcurrentLeaders violated"
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "raft_tla_tpu", *args],
+        capture_output=True, text=True, timeout=1200)
+
+
+def test_punctuated_search_cli(tmp_path):
+    """End-to-end punctuated search (raft.tla:1198-1210): seed = the
+    golden ConcurrentLeaders end state; a seeded check with the CWCL
+    action constraint finds CommitWhenConcurrentLeaders quickly."""
+    sv, h = replay(CONCURRENT_LEADERS_LABELS)[-1]
+    seed = tmp_path / "seed.json"
+    seed.write_text(json.dumps(state_to_obj(sv, h)))
+    r = run_cli(
+        "check", TLC_CFG, "--engine", "tpu",
+        "--seed-trace", str(seed),
+        "--invariant", "CommitWhenConcurrentLeaders",
+        "--action-constraint",
+        "CommitWhenConcurrentLeaders_action_constraint",
+        "--max-log-length", "1", "--max-client-requests", "2",
+        "--max-timeouts", "1", "--max-restarts", "0", "--max-terms", "4",
+        "--max-depth", "12", "--chunk", "256")
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    head = json.loads(r.stdout.splitlines()[0])
+    assert head["violations"] >= 1
+    assert "CommitWhenConcurrentLeaders" in r.stdout
+
+
+def test_emit_seed_roundtrip(tmp_path):
+    """`trace --emit-seed` writes a seed that `check --seed-trace`
+    accepts on both engines (the CLI surface of punctuated search)."""
+    common = [TLC_CFG, "--servers", "2", "--max-timeouts", "1",
+              "--max-log-length", "1", "--max-client-requests", "1"]
+    seed = tmp_path / "first_leader.json"
+    r = run_cli("trace", *common, "--target", "FirstBecomeLeader",
+                "--emit-seed", str(seed))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    obj = json.loads(seed.read_text())
+    assert "state" in obj and "nonview" in obj
+    outs = {}
+    for engine in ("tpu", "oracle"):
+        r2 = run_cli("check", *common, "--engine", engine,
+                     "--seed-trace", str(seed), "--max-depth", "6",
+                     "--keep-going")
+        assert r2.returncode == 0, (r2.stdout, r2.stderr)
+        outs[engine] = json.loads(r2.stdout.splitlines()[0])
+    assert outs["tpu"]["distinct_states"] == \
+        outs["oracle"]["distinct_states"]
+    assert outs["tpu"]["depth"] == outs["oracle"]["depth"]
