@@ -1,21 +1,33 @@
 """Level-synchronous BFS engine: TLC's worker loop, TPU-shaped.
 
 Replaces the reference's external checker (SURVEY §2.13: TLC's BFS +
-fingerprint set + invariant eval) with a two-phase device pipeline per
-frontier chunk:
+fingerprint set + invariant eval) with a **device-resident** pipeline:
+the frontier, the candidate expansion, the fingerprint set (a sorted
+multi-word key array in HBM), the per-level dedup, the invariant /
+constraint evaluation and the next-frontier compaction all live on
+device.  Per frontier chunk the host issues ONE fused jit call
+(expand + fingerprint + action constraints + intra-chunk first-seen
+dedup + membership probe + scatter into the level buffer) with a
+donated carry, so chunk steps pipeline asynchronously; the only
+per-level synchronization is reading back a handful of scalars
+(new-state count, violation count, next-frontier size).
 
-  phase 1 (jit):  expand the chunk over the action grid (engine/expand),
-                  evaluate ACTION_CONSTRAINTS against the parent, and
-                  fingerprint every candidate (engine/fingerprint)
-  host:           first-seen dedup in candidate order (stable — mirrors
-                  the oracle BFS ordering) against the visited set
-  phase 2 (jit):  on the *new* states only: invariant verdicts +
-                  CONSTRAINT masks (prune-expansion semantics, §2.8)
+State identity follows TLC's semantics: the visited set stores the
+symmetry-canonical VIEW fingerprints (engine/fingerprint) as
+``n_streams`` u32 words compared lexicographically; first-seen survivor
+order matches the Python oracle (chunk-sequential, candidate-index
+order within a chunk — SURVEY §7.4 pt 5).  CONSTRAINT semantics are
+prune-not-reject: violating states are counted and checked but not
+expanded (§2.8).  Parent pointers (state-id, lane-id) stream to the
+host per level for trace reconstruction (SURVEY §7.2 L5).
 
-The visited set is a sorted uint64 fingerprint array merged per level —
-the host-side analog of TLC's fingerprint set.  Parent pointers
-(state-id, lane-id) append per level for trace reconstruction
-(SURVEY §7.2 L5).  Multi-device sharding wraps phase 1 (parallel/).
+Capacity model: the visited set (VCAP keys) and the per-level buffer
+(LCAP states) are fixed-shape device arrays padded with an all-ones
+sentinel key; when a level or the visited set outgrows its capacity the
+engine doubles the cap, recompiles (one extra jit cache entry per
+doubling) and — for the level buffer — replays the level from the
+intact frontier (the visited set is only merged at level end, so the
+replay is exact).
 """
 
 from __future__ import annotations
@@ -27,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..config import CANDIDATE, ModelConfig
 from ..models.raft import Hist, State, init_state
@@ -36,6 +49,8 @@ from ..ops.layout import Layout
 from ..ops.vpredicates import Predicates
 from .expand import Expander
 from .fingerprint import Fingerprinter, combine_u64
+
+U32MAX = jnp.uint32(0xFFFFFFFF)
 
 
 def _cat(chunks: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -51,23 +66,6 @@ def fp_key(fp_u32: np.ndarray) -> np.ndarray:
         return u64[:, 0]
     dtype = np.dtype([(f"w{i}", "<u8") for i in range(u64.shape[1])])
     return np.ascontiguousarray(u64).view(dtype)[:, 0]
-
-
-def sorted_member(sorted_arr: np.ndarray, keys: np.ndarray) -> np.ndarray:
-    """Membership of keys in a sorted array via searchsorted (the host
-    analog of TLC's fingerprint-set probe)."""
-    idx = np.searchsorted(sorted_arr, keys)
-    idx = np.minimum(idx, max(len(sorted_arr) - 1, 0))
-    if len(sorted_arr) == 0:
-        return np.zeros(len(keys), bool)
-    return sorted_arr[idx] == keys
-
-
-def sorted_merge(sorted_arr: np.ndarray, new_keys: np.ndarray) -> np.ndarray:
-    """O(N+M) merge of new (unsorted, unique) keys into a sorted array."""
-    new_sorted = np.sort(new_keys)
-    pos = np.searchsorted(sorted_arr, new_sorted)
-    return np.insert(sorted_arr, pos, new_sorted)
 
 
 def _take(arrs: Dict[str, np.ndarray], idx) -> Dict[str, np.ndarray]:
@@ -92,19 +90,31 @@ class CheckResult:
     level_sizes: List[int] = field(default_factory=list)
     seconds: float = 0.0
     overflow_faults: int = 0
+    phase_seconds: Dict[str, float] = field(default_factory=dict)
 
     @property
     def states_per_sec(self):
         return self.distinct_states / max(self.seconds, 1e-9)
 
 
+def _ceil_log2(n: int) -> int:
+    return max(1, int(np.ceil(np.log2(max(n, 2)))))
+
+
 class Engine:
-    """One compiled checker instance per (ModelConfig, chunk size)."""
+    """One compiled checker instance per (ModelConfig, chunk size).
+
+    chunk    — frontier states expanded per fused device call.
+    lcap     — initial per-level buffer capacity (states); doubles on
+               overflow (the level is replayed from the intact frontier).
+    vcap     — initial visited-set capacity (fingerprint keys).
+    """
 
     def __init__(self, cfg: ModelConfig, chunk: int = 512,
-                 store_states: bool = True):
+                 store_states: bool = True,
+                 lcap: int = 1 << 14, vcap: int = 1 << 17):
         self.cfg = cfg
-        self.chunk = chunk
+        self.chunk = max(16, int(chunk))
         self.store_states = store_states
         self.lay = Layout(cfg)
         self.kern = RaftKernels(self.lay)
@@ -116,16 +126,22 @@ class Engine:
         self.act_names = list(cfg.action_constraints)
         self.labels = self.expander.lane_labels()
         self.A = self.expander.n_lanes
+        self.W = self.fpr.n_streams           # u32 words per dedup key
+        # capacities (LCAP always a multiple of chunk)
+        self.LCAP = self._round_cap(max(lcap, 4 * self.chunk))
+        self.VCAP = int(vcap)
         self._phase1 = jax.jit(self._phase1_impl)
         self._phase2 = jax.jit(self._phase2_impl)
-        # fixed-size on-device row gather: only SELECTED candidates ever
-        # leave the device (transferring the full [B, A, ...] candidate
-        # block per chunk dominated wall time on the TPU tunnel)
-        self._gather = jax.jit(
-            lambda cand, idx: {
-                k: v.reshape((-1,) + v.shape[2:])[idx]
-                for k, v in cand.items()})
+        self._step_jit = jax.jit(self._chunk_step_impl, donate_argnums=0)
+        self._fin_jit = jax.jit(self._finalize_impl, donate_argnums=0)
 
+    def _round_cap(self, n: int) -> int:
+        c = self.chunk
+        return ((int(n) + c - 1) // c) * c
+
+    # ------------------------------------------------------------------
+    # phase 1: expand + action constraints + fingerprint (also used by
+    # the driver entry point and the sharded engine)
     # ------------------------------------------------------------------
 
     def _act_ok(self, parent_sv, cand_sv):
@@ -167,15 +183,181 @@ class Engine:
         return jax.vmap(one)(svb)
 
     # ------------------------------------------------------------------
+    # device-resident dedup primitives
+    # ------------------------------------------------------------------
 
-    def _pad(self, arrs: Dict[str, np.ndarray], n: int):
-        cur = len(arrs["ct"])
-        if cur == n:
-            return arrs, np.ones(n, bool)
-        pad = n - cur
-        out = {k: np.concatenate([v, np.repeat(v[:1], pad, axis=0)])
-               for k, v in arrs.items()}
-        return out, np.concatenate([np.ones(cur, bool), np.zeros(pad, bool)])
+    def _lower_bound(self, arrs: Tuple[jnp.ndarray, ...],
+                     qs: Tuple[jnp.ndarray, ...]) -> jnp.ndarray:
+        """First index where the lexicographic W-word key >= query.
+        arrs: W × u32[C] sorted ascending (sentinel-padded); qs: W × u32[N].
+        Branchless fixed-depth binary search (the HBM-resident analog of
+        TLC's fingerprint-set probe)."""
+        C = arrs[0].shape[0]
+        lo = jnp.zeros(qs[0].shape, jnp.int32)
+        hi = jnp.full(qs[0].shape, C, jnp.int32)
+        for _ in range(_ceil_log2(C) + 1):
+            mid = (lo + hi) >> 1
+            midc = jnp.clip(mid, 0, C - 1)
+            less = jnp.zeros(qs[0].shape, bool)
+            eq = jnp.ones(qs[0].shape, bool)
+            for w in range(self.W):
+                kw = arrs[w][midc]
+                less = less | (eq & (kw < qs[w]))
+                eq = eq & (kw == qs[w])
+            lo = jnp.where(less, mid + 1, lo)
+            hi = jnp.where(less, hi, mid)
+        return lo
+
+    def _member(self, arrs, qs) -> jnp.ndarray:
+        C = arrs[0].shape[0]
+        pos = jnp.clip(self._lower_bound(arrs, qs), 0, C - 1)
+        eq = jnp.ones(qs[0].shape, bool)
+        for w in range(self.W):
+            eq = eq & (arrs[w][pos] == qs[w])
+        return eq
+
+    def _sorted_insert(self, arrs, ins, cap):
+        """Merge `ins` (W × u32[M], sentinel for dead lanes) into the
+        sorted sentinel-padded `arrs` (W × u32[cap]) via concat + sort;
+        real keys must fit in cap (checked by the caller's overflow
+        logic)."""
+        cat = tuple(jnp.concatenate([arrs[w], ins[w]])
+                    for w in range(self.W))
+        merged = lax.sort(cat, num_keys=self.W)
+        return tuple(merged[w][:cap] for w in range(self.W))
+
+    # ------------------------------------------------------------------
+    # fused per-chunk step (ONE device call per frontier chunk)
+    # ------------------------------------------------------------------
+
+    def _chunk_step_impl(self, carry, base):
+        """Expand frontier[base:base+chunk], fingerprint, dedup
+        (intra-chunk first-seen + visited + level membership) and
+        scatter the fresh states into the level buffer.  Everything
+        stays on device; `carry` is donated so buffers are reused."""
+        B, A, W = self.chunk, self.A, self.W
+        LCAP = carry["lpar"].shape[0]
+        N = B * A
+        sv = {k: lax.dynamic_slice_in_dim(v, base, B)
+              for k, v in carry["front"].items()}
+        pgids = lax.dynamic_slice_in_dim(carry["gids"], base, B)
+        ok, cand, fp = self._phase1_impl(sv)
+        valid = (base + jnp.arange(B, dtype=jnp.int32)) < carry["n_front"]
+        okf = (ok & valid[:, None]).reshape(N)
+        n_gen = carry["n_gen"] + okf.sum(dtype=jnp.int32)
+
+        kws = tuple(jnp.where(okf, fp[..., w].reshape(N), U32MAX)
+                    for w in range(W))
+        idx = jnp.arange(N, dtype=jnp.int32)
+        sorted_ops = lax.sort(kws + (idx,), num_keys=W, is_stable=True)
+        sk, sidx = sorted_ops[:W], sorted_ops[W]
+        # first of each equal-key run; stability => smallest original
+        # index survives (the oracle's first-seen rule)
+        diff = jnp.zeros(N, bool).at[0].set(True)
+        for w in range(W):
+            diff = diff | jnp.concatenate(
+                [jnp.ones(1, bool), sk[w][1:] != sk[w][:-1]])
+        is_sent = jnp.ones(N, bool)
+        for w in range(W):
+            is_sent = is_sent & (sk[w] == U32MAX)
+        surv = diff & ~is_sent
+        surv = surv & ~self._member(carry["vis"], sk)
+        surv = surv & ~self._member(carry["lvlk"], sk)
+
+        fresh = jnp.zeros(N, bool).at[sidx].set(surv)   # original order
+        offs = jnp.cumsum(fresh.astype(jnp.int32)) - 1
+        pos = jnp.where(fresh, carry["n_lvl"] + offs, LCAP)   # OOB drops
+        n_fresh = fresh.sum(dtype=jnp.int32)
+        ovf = carry["ovf"] | (carry["n_lvl"] + n_fresh > LCAP)
+
+        lvl = {k: v.at[pos].set(cand[k].reshape((N,) + v.shape[1:]),
+                                mode="drop")
+               for k, v in carry["lvl"].items()}
+        lpar = carry["lpar"].at[pos].set(pgids[idx // A], mode="drop")
+        llane = carry["llane"].at[pos].set(idx % A, mode="drop")
+        ins = tuple(jnp.where(surv, sk[w], U32MAX) for w in range(W))
+        lvlk = self._sorted_insert(carry["lvlk"], ins, LCAP)
+        return dict(carry, lvl=lvl, lpar=lpar, llane=llane, lvlk=lvlk,
+                    n_lvl=jnp.minimum(carry["n_lvl"] + n_fresh, LCAP),
+                    n_gen=n_gen, ovf=ovf)
+
+    # ------------------------------------------------------------------
+    # per-level finalize: invariants/constraints on the new states,
+    # next-frontier compaction, visited merge — one device call
+    # ------------------------------------------------------------------
+
+    def _finalize_impl(self, carry, g_off):
+        LCAP = carry["lpar"].shape[0]
+        VCAP = carry["vis"][0].shape[0]
+        n_lvl = carry["n_lvl"]
+        validrow = jnp.arange(LCAP, dtype=jnp.int32) < n_lvl
+        inv, con = self._phase2_impl(carry["lvl"])
+        inv_ok = inv | ~validrow[:, None] if self.inv_names else inv
+        n_viol = (~inv_ok).sum(dtype=jnp.int32)
+        faults = ((carry["lvl"]["ctr"][:, C_OVERFLOW] > 0) &
+                  validrow).sum(dtype=jnp.int32)
+        # CONSTRAINT = checked but not expanded (SURVEY §2.8)
+        expand_mask = con & validrow
+        fpos = jnp.where(expand_mask,
+                         jnp.cumsum(expand_mask.astype(jnp.int32)) - 1,
+                         LCAP)
+        front = {k: v.at[fpos].set(carry["lvl"][k], mode="drop")
+                 for k, v in carry["front"].items()}
+        gids = carry["gids"].at[fpos].set(
+            g_off + jnp.arange(LCAP, dtype=jnp.int32), mode="drop")
+        n_front = expand_mask.sum(dtype=jnp.int32)
+        vis = self._sorted_insert(carry["vis"], carry["lvlk"], VCAP)
+        lvlk = tuple(jnp.full((LCAP,), U32MAX) for _ in range(self.W))
+        new_carry = dict(carry, vis=vis, lvlk=lvlk, front=front,
+                         gids=gids, n_front=n_front,
+                         n_lvl=jnp.int32(0), ovf=jnp.bool_(False))
+        return new_carry, dict(inv_ok=inv_ok, n_viol=n_viol,
+                               faults=faults, n_front=n_front,
+                               n_lvl=n_lvl)
+
+    # ------------------------------------------------------------------
+
+    def _fresh_carry(self, lcap: int, vcap: int):
+        one = encode(self.lay, *init_state(self.cfg))
+        zeros = {k: jnp.zeros((lcap,) + v.shape, dtype=v.dtype)
+                 for k, v in one.items()}
+        sent = tuple(jnp.full((lcap,), U32MAX) for _ in range(self.W))
+        return dict(
+            vis=tuple(jnp.full((vcap,), U32MAX) for _ in range(self.W)),
+            lvlk=sent,
+            lvl=zeros,
+            lpar=jnp.full((lcap,), -1, jnp.int32),
+            llane=jnp.full((lcap,), -1, jnp.int32),
+            n_lvl=jnp.int32(0),
+            n_gen=jnp.int32(0),
+            ovf=jnp.bool_(False),
+            front={k: jnp.zeros_like(v) for k, v in zeros.items()},
+            gids=jnp.full((lcap,), -1, jnp.int32),
+            n_front=jnp.int32(0),
+        )
+
+    def _grow(self, carry, lcap: int, vcap: int):
+        """Re-home a carry into bigger capacity buffers (visited keys and
+        the frontier survive; the level buffer is reset — callers replay
+        the level)."""
+        old_lcap = carry["lpar"].shape[0]
+        new = self._fresh_carry(lcap, vcap)
+        ovcap = carry["vis"][0].shape[0]
+        new["vis"] = tuple(
+            jnp.concatenate([carry["vis"][w],
+                             jnp.full((vcap - ovcap,), U32MAX)])
+            for w in range(self.W))
+        pad = lcap - old_lcap
+        new["front"] = {k: jnp.concatenate(
+            [carry["front"][k], jnp.zeros((pad,) + v.shape[1:], v.dtype)])
+            for k, v in carry["front"].items()}
+        new["gids"] = jnp.concatenate(
+            [carry["gids"], jnp.full((pad,), -1, jnp.int32)])
+        new["n_front"] = carry["n_front"]
+        new["n_gen"] = carry["n_gen"]
+        return new
+
+    # ------------------------------------------------------------------
 
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
@@ -193,132 +375,131 @@ class Engine:
             if isinstance(s, dict) else
             {k: v[None] for k, v in encode(lay, *s).items()}
             for s in init_list])
-        # fingerprint + check the roots
         rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
-        root_fp = fp_key(np.asarray(jax.vmap(self.fpr.fingerprint)(rootsb)))
-        _uniq, first_idx = np.unique(root_fp, return_index=True)
+        root_fp = np.asarray(jax.vmap(self.fpr.fingerprint)(rootsb))
+        root_keys = fp_key(root_fp)
+        _uniq, first_idx = np.unique(root_keys, return_index=True)
         first_idx.sort()
         roots = _take(init_arrs, first_idx)
         n_roots = len(first_idx)
 
         res = CheckResult(distinct_states=0, generated_states=n_roots,
                           depth=0)
-        visited = np.sort(root_fp[first_idx])
         self._states: List[Dict[str, np.ndarray]] = []
-        self._parents = [np.full(n_roots, -1, np.int64)]
-        self._lanes = [np.full(n_roots, -1, np.int32)]
+        self._parents: List[np.ndarray] = []
+        self._lanes: List[np.ndarray] = []
+
+        while self.LCAP < 2 * n_roots:
+            self.LCAP *= 2
+        carry = self._fresh_carry(self.LCAP, self.VCAP)
+        # roots enter through the same admit path as every level: place
+        # them in the level buffer and finalize.
+        pad = self.LCAP - n_roots
+        carry["lvl"] = {k: jnp.asarray(np.concatenate(
+            [roots[k], np.zeros((pad,) + roots[k].shape[1:],
+                                roots[k].dtype)]))
+            for k in roots}
+        rk = np.asarray(root_fp[first_idx], dtype=np.uint32)
+        # lexicographic row sort (np.lexsort: LAST key is primary)
+        order = np.lexsort(tuple(rk[:, w]
+                                 for w in range(self.W - 1, -1, -1)))
+        carry["lvlk"] = tuple(jnp.asarray(np.concatenate(
+            [rk[order, w], np.full(pad, 0xFFFFFFFF, np.uint32)]))
+            for w in range(self.W))
+        carry["n_lvl"] = jnp.int32(n_roots)
         n_states = 0
+        n_vis = 0
+        depth = 0
+        t_dev = 0.0
 
-        def admit(new_arrs):
-            """Check invariants/constraints on new distinct states;
-            returns (expandable subset, their global ids) — CONSTRAINT
-            semantics: violating states are checked but not expanded.
-            Runs phase 2 in fixed-size chunks so the jit compiles ONCE
-            (variable-size padding would recompile per level)."""
-            nonlocal n_states
-            m = len(new_arrs["ct"])
-            res.distinct_states += m
-            inv_parts, con_parts = [], []
-            for base in range(0, m, self.chunk):
-                piece = _take(new_arrs, slice(base, base + self.chunk))
-                padded, _valid = self._pad(piece, self.chunk)
-                inv_p, con_p = self._phase2(
-                    {k: jnp.asarray(v) for k, v in padded.items()})
-                n_live = len(piece["ct"])
-                inv_parts.append(np.asarray(inv_p)[:n_live])
-                con_parts.append(np.asarray(con_p)[:n_live])
-            inv = np.concatenate(inv_parts) if inv_parts else \
-                np.ones((0, len(self.inv_names)), bool)
-            con = np.concatenate(con_parts) if con_parts else \
-                np.ones((0,), bool)
-            res.overflow_faults += int(
-                (new_arrs["ctr"][:, C_OVERFLOW] > 0).sum())
-            for j, nm in enumerate(self.inv_names):
-                for s in np.nonzero(~inv[:, j])[0]:
-                    vsv, vh = decode(self.lay, _take(new_arrs, s))
-                    res.violations.append(
-                        Violation(nm, n_states + s, state=vsv, hist=vh))
+        def run_finalize(carry):
+            nonlocal n_vis
+            need = n_vis + int(np.asarray(carry["n_lvl"]))
+            if need > self.VCAP:
+                while self.VCAP < need:
+                    self.VCAP *= 2
+                carry = self._grow_vis(carry, self.VCAP)
+            return self._fin_jit(carry, jnp.int32(n_states))
+
+        def harvest(carry, out):
+            """Per-level host bookkeeping: counts, parents/lanes,
+            violations, optional state store."""
+            nonlocal n_states, n_vis
+            n_lvl = int(np.asarray(out["n_lvl"]))
+            res.distinct_states += n_lvl
+            res.overflow_faults += int(np.asarray(out["faults"]))
+            self._parents.append(
+                np.asarray(carry["lpar"])[:n_lvl].copy())
+            self._lanes.append(np.asarray(carry["llane"])[:n_lvl].copy())
             if self.store_states:
-                self._states.append(new_arrs)
-            keep = np.nonzero(con)[0]
-            gids = n_states + keep
-            n_states += m
-            return _take(new_arrs, keep), gids
+                self._states.append(
+                    {k: np.asarray(v)[:n_lvl].copy()
+                     for k, v in carry["lvl"].items()})
+            n_viol = int(np.asarray(out["n_viol"]))
+            if n_viol:
+                inv_ok = np.asarray(out["inv_ok"])[:n_lvl]
+                rows = {k: np.asarray(v)[:n_lvl]
+                        for k, v in carry["lvl"].items()}
+                for j, nm in enumerate(self.inv_names):
+                    for s in np.nonzero(~inv_ok[:, j])[0]:
+                        vsv, vh = decode(self.lay, _take(rows, s))
+                        res.violations.append(
+                            Violation(nm, n_states + int(s),
+                                      state=vsv, hist=vh))
+            n_states += n_lvl
+            n_vis += n_lvl
+            return int(np.asarray(out["n_front"]))
 
-        frontier, front_ids = admit(roots)
+        carry, out = run_finalize(carry)
+        n_front = harvest(carry, out)
         if stop_on_violation and res.violations:
             res.seconds = time.time() - t0
-            res.depth = 0
             return res
 
-        depth = 0
-        while len(frontier["ct"]) and depth < max_depth and \
+        while n_front and depth < max_depth and \
                 res.distinct_states < max_states:
             depth += 1
-            level_new: List[Dict[str, np.ndarray]] = []
-            level_parents: List[np.ndarray] = []
-            level_lanes: List[np.ndarray] = []
-            level_fps: List[np.ndarray] = []
-            level_seen = visited[:0]          # empty, same key dtype
-            n_front = len(frontier["ct"])
-            for base in range(0, n_front, self.chunk):
-                piece = _take(frontier, slice(base, base + self.chunk))
-                piece_ids = front_ids[base:base + self.chunk]
-                padded, valid_b = self._pad(piece, self.chunk)
-                ok, cand, fp = self._phase1(
-                    {k: jnp.asarray(v) for k, v in padded.items()})
-                okn = np.asarray(ok) & valid_b[:, None]          # [B, A]
-                keys = fp_key(
-                    np.asarray(fp).reshape(-1, self.fpr.n_streams))
-                flat_ok = okn.reshape(-1)
-                res.generated_states += int(flat_ok.sum())
-                cand_order = np.nonzero(flat_ok)[0]
-                # first occurrence in candidate order (mirrors the
-                # oracle's first-seen survivor rule, SURVEY §7.4 pt 5)
-                _u, first = np.unique(keys[cand_order], return_index=True)
-                first.sort()
-                sel = cand_order[first]
-                fps_sel = keys[sel]
-                fresh = ~sorted_member(visited, fps_sel) & \
-                    ~sorted_member(level_seen, fps_sel)
-                sel = sel[fresh]
-                if len(sel) == 0:
-                    continue
-                pieces = []
-                for b2 in range(0, len(sel), self.chunk):
-                    piece_sel = sel[b2:b2 + self.chunk]
-                    padded_sel = np.zeros(self.chunk, np.int32)
-                    padded_sel[:len(piece_sel)] = piece_sel
-                    g = self._gather(cand, jnp.asarray(padded_sel))
-                    pieces.append({k: np.asarray(v)[:len(piece_sel)]
-                                   for k, v in g.items()})
-                new_arrs = _cat(pieces)
-                level_new.append(new_arrs)
-                level_fps.append(fps_sel[fresh])
-                level_seen = sorted_merge(level_seen, fps_sel[fresh])
-                level_parents.append(piece_ids[sel // self.A])
-                level_lanes.append((sel % self.A).astype(np.int32))
-            if not level_new:
-                res.level_sizes.append(0)
-                break
-            new_arrs = _cat(level_new)
-            new_fps = np.concatenate(level_fps)
-            self._parents.append(np.concatenate(level_parents))
-            self._lanes.append(np.concatenate(level_lanes))
-            frontier, front_ids = admit(new_arrs)
-            visited = sorted_merge(visited, new_fps)
-            # expandable count, matching the oracle's level_sizes
-            # (models/explore.py appends len(nxt) post-constraint)
-            res.level_sizes.append(len(frontier["ct"]))
+            t1 = time.time()
+            while True:
+                n_chunks = (n_front + self.chunk - 1) // self.chunk
+                for c in range(n_chunks):
+                    carry = self._step_jit(carry, jnp.int32(c * self.chunk))
+                if not bool(np.asarray(carry["ovf"])):
+                    break
+                # level buffer overflow: double LCAP and replay the
+                # level (visited is only merged at finalize, so replay
+                # from the intact frontier is exact)
+                self.LCAP *= 2
+                if verbose:
+                    print(f"level {depth}: buffer overflow, growing "
+                          f"LCAP to {self.LCAP}")
+                carry = self._grow(carry, self.LCAP, self.VCAP)
+            carry, out = run_finalize(carry)
+            res.generated_states += int(np.asarray(carry["n_gen"]))
+            carry["n_gen"] = jnp.int32(0)
+            n_front = harvest(carry, out)
+            t_dev += time.time() - t1
+            res.level_sizes.append(n_front)
             if stop_on_violation and res.violations:
                 break
             if verbose:
-                print(f"depth {depth}: +{len(new_fps)} states "
+                n_lvl = int(np.asarray(out["n_lvl"]))
+                print(f"depth {depth}: +{n_lvl} states "
                       f"(total {res.distinct_states}), "
-                      f"frontier {len(frontier['ct'])}")
+                      f"frontier {n_front}")
         res.depth = depth
         res.seconds = time.time() - t0
+        res.phase_seconds["device_levels"] = t_dev
         return res
+
+    def _grow_vis(self, carry, vcap: int):
+        ovcap = carry["vis"][0].shape[0]
+        carry = dict(carry)
+        carry["vis"] = tuple(
+            jnp.concatenate([carry["vis"][w],
+                             jnp.full((vcap - ovcap,), U32MAX)])
+            for w in range(self.W))
+        return carry
 
     # ------------------------------------------------------------------
 
