@@ -196,7 +196,7 @@ class Engine:
         lo = jnp.zeros(qs[0].shape, jnp.int32)
         hi = jnp.full(qs[0].shape, C, jnp.int32)
         for _ in range(_ceil_log2(C) + 1):
-            mid = (lo + hi) >> 1
+            mid = lo + ((hi - lo) >> 1)
             midc = jnp.clip(mid, 0, C - 1)
             less = jnp.zeros(qs[0].shape, bool)
             eq = jnp.ones(qs[0].shape, bool)
@@ -342,11 +342,7 @@ class Engine:
         the level)."""
         old_lcap = carry["lpar"].shape[0]
         new = self._fresh_carry(lcap, vcap)
-        ovcap = carry["vis"][0].shape[0]
-        new["vis"] = tuple(
-            jnp.concatenate([carry["vis"][w],
-                             jnp.full((vcap - ovcap,), U32MAX)])
-            for w in range(self.W))
+        new["vis"] = self._grow_vis(carry, vcap)["vis"]
         pad = lcap - old_lcap
         new["front"] = {k: jnp.concatenate(
             [carry["front"][k], jnp.zeros((pad,) + v.shape[1:], v.dtype)])
@@ -354,7 +350,8 @@ class Engine:
         new["gids"] = jnp.concatenate(
             [carry["gids"], jnp.full((pad,), -1, jnp.int32)])
         new["n_front"] = carry["n_front"]
-        new["n_gen"] = carry["n_gen"]
+        # n_gen stays 0: the caller replays the whole level from the
+        # intact frontier, so keeping the partial count would double it
         return new
 
     # ------------------------------------------------------------------
@@ -428,12 +425,12 @@ class Engine:
             n_lvl = int(np.asarray(out["n_lvl"]))
             res.distinct_states += n_lvl
             res.overflow_faults += int(np.asarray(out["faults"]))
-            self._parents.append(
-                np.asarray(carry["lpar"])[:n_lvl].copy())
-            self._lanes.append(np.asarray(carry["llane"])[:n_lvl].copy())
+            # slice on device, transfer only live rows
+            self._parents.append(np.asarray(carry["lpar"][:n_lvl]))
+            self._lanes.append(np.asarray(carry["llane"][:n_lvl]))
             if self.store_states:
                 self._states.append(
-                    {k: np.asarray(v)[:n_lvl].copy()
+                    {k: np.asarray(v[:n_lvl])
                      for k, v in carry["lvl"].items()})
             n_viol = int(np.asarray(out["n_viol"]))
             if n_viol:
@@ -448,6 +445,12 @@ class Engine:
                                       state=vsv, hist=vh))
             n_states += n_lvl
             n_vis += n_lvl
+            # global state ids are device int32 (gids/lpar); fail loud
+            # rather than wrap if a run ever approaches that scale
+            if n_states >= 2 ** 31 - 1:
+                raise RuntimeError(
+                    "state-id space exhausted (2^31 ids): run exceeds "
+                    "the engine's int32 global-id width")
             return int(np.asarray(out["n_front"]))
 
         carry, out = run_finalize(carry)

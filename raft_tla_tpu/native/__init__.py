@@ -47,13 +47,32 @@ _lib = None
 
 
 def _build() -> Path:
+    """Compile the checker into a cache path keyed on a content hash of
+    the source (never committed; a stale or foreign-built object can
+    never be picked up).  -march=native is attempted first for speed and
+    dropped automatically on toolchains/microarchitectures that reject
+    it."""
+    import hashlib
     src = Path(__file__).parent / "raft_checker.cc"
-    so = Path(__file__).parent / "raft_checker.so"
-    if so.exists() and so.stat().st_mtime >= src.stat().st_mtime:
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    so = Path(__file__).parent / f"raft_checker.{digest}.so"
+    if so.exists():
         return so
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", str(so), str(src), "-lpthread"]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    # build into a process-unique temp and rename atomically so
+    # concurrent builders (e.g. parallel pytest workers) never unlink or
+    # half-overwrite an object another process is about to CDLL
+    tmp = so.with_suffix(f".tmp{os.getpid()}")
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", str(tmp), str(src), "-lpthread"]
+    try:
+        subprocess.run(base[:2] + ["-march=native"] + base[2:],
+                       check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError:
+        subprocess.run(base, check=True, capture_output=True, text=True)
+    os.replace(tmp, so)
+    for stale in so.parent.glob("raft_checker*.so"):
+        if stale != so:
+            stale.unlink(missing_ok=True)
     return so
 
 
@@ -125,6 +144,10 @@ def _pack_cfg(cfg: ModelConfig, threads: int, max_depth: int,
 def check(cfg: ModelConfig, threads: int = os.cpu_count() or 8,
           max_depth: int = 2 ** 60, max_states: int = 2 ** 60,
           stop_on_violation: bool = False) -> NativeResult:
+    """``max_states`` is a level-granular budget, matching the TPU
+    engine's semantics: expansion stops at the first level boundary at
+    or past the cap, so the returned count may exceed it by up to one
+    level's worth of states."""
     import time
     lib = _load()
     arr = _pack_cfg(cfg, threads, max_depth, max_states,
