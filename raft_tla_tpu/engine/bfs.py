@@ -52,6 +52,28 @@ from .fingerprint import Fingerprinter, combine_u64
 
 U32MAX = jnp.uint32(0xFFFFFFFF)
 
+_CACHE_ENABLED = False
+
+
+def enable_persistent_compilation_cache():
+    """Persist XLA executables across processes (TPU compiles of the
+    fused BFS kernels run 30-50s; warm loads are sub-second).  Honors a
+    user-set JAX_COMPILATION_CACHE_DIR; defaults to a repo-local dir."""
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED:
+        return
+    _CACHE_ENABLED = True
+    import os
+    path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass                  # older jax without the knob: run uncached
+
 
 def _cat(chunks: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
     return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
@@ -112,7 +134,9 @@ class Engine:
 
     def __init__(self, cfg: ModelConfig, chunk: int = 512,
                  store_states: bool = True,
-                 lcap: int = 1 << 14, vcap: int = 1 << 17):
+                 lcap: int = 1 << 14, vcap: int = 1 << 17,
+                 fcap: Optional[int] = None):
+        enable_persistent_compilation_cache()
         self.cfg = cfg
         self.chunk = max(16, int(chunk))
         self.store_states = store_states
@@ -127,13 +151,20 @@ class Engine:
         self.labels = self.expander.lane_labels()
         self.A = self.expander.n_lanes
         self.W = self.fpr.n_streams           # u32 words per dedup key
-        # capacities (LCAP always a multiple of chunk)
-        self.LCAP = self._round_cap(max(lcap, 4 * self.chunk))
+        # capacities (LCAP always a multiple of chunk).  FCAP bounds the
+        # fresh-per-chunk compaction buffer; LCAP reserves an FCAP-sized
+        # append margin (usable level capacity is LCAP - FCAP).
+        self.FCAP = int(fcap) if fcap else min(
+            self.chunk * self.A, max(self.chunk * 16, 1 << 13))
+        self.LCAP = self._round_cap(
+            max(lcap, 4 * self.chunk, 4 * self.FCAP))
         self.VCAP = int(vcap)
         self._phase1 = jax.jit(self._phase1_impl)
         self._phase2 = jax.jit(self._phase2_impl)
         self._step_jit = jax.jit(self._chunk_step_impl, donate_argnums=0)
         self._fin_jit = jax.jit(self._finalize_impl, donate_argnums=0)
+        self._rootfp_jit = jax.jit(
+            lambda svb: jax.vmap(self.fpr.fingerprint)(svb))
 
     def _round_cap(self, n: int) -> int:
         c = self.chunk
@@ -230,109 +261,243 @@ class Engine:
     # fused per-chunk step (ONE device call per frontier chunk)
     # ------------------------------------------------------------------
 
-    def _chunk_step_impl(self, carry, base):
+    def _chunk_step_impl(self, carry):
         """Expand frontier[base:base+chunk], fingerprint, dedup
         (intra-chunk first-seen + visited + level membership) and
-        scatter the fresh states into the level buffer.  Everything
-        stays on device; `carry` is donated so buffers are reused."""
+        append the fresh states to the level buffer.  Everything stays
+        on device; `carry` is donated so buffers are reused.
+
+        Shaped for the TPU's strengths (profiled on hardware):
+
+        - enabled lanes are compacted to the FCAP buffer *before*
+          fingerprinting, so the expensive min-over-perms hash runs on
+          ~enabled candidates instead of the full B×A lane grid
+          (typically ~10× fewer — the fingerprint dominated phase 1);
+        - the intra-chunk dedup sort is *unstable* with the compaction
+          slot as an extra sort key (first-of-run then still has the
+          smallest original lane index — the oracle's first-seen rule —
+          while avoiding XLA's slow stable-sort path);
+        - the level write is gather + contiguous dynamic_update_slice
+          instead of a full-width scatter (TPU scatters are an order of
+          magnitude slower than gathers at these shapes);
+        - every phase boundary carries an optimization_barrier: without
+          them XLA rematerializes the huge expansion graph into each
+          consumer (measured 140ms/chunk vs ~20ms with barriers)."""
         B, A, W = self.chunk, self.A, self.W
         LCAP = carry["lpar"].shape[0]
+        FCAP = carry["cidx"].shape[0]
         N = B * A
+        base = carry["base"]        # device-resident chunk cursor: a
+        # host-passed scalar would cost a blocking ~100ms host->device
+        # transfer per chunk through the tunneled-TPU runtime
         sv = {k: lax.dynamic_slice_in_dim(v, base, B)
               for k, v in carry["front"].items()}
-        pgids = lax.dynamic_slice_in_dim(carry["gids"], base, B)
-        ok, cand, fp = self._phase1_impl(sv)
-        valid = (base + jnp.arange(B, dtype=jnp.int32)) < carry["n_front"]
+        fmask = lax.dynamic_slice_in_dim(carry["fmask"], base, B)
+        ok, cand = lax.optimization_barrier(
+            self.expander._expand_impl(sv))               # [B,A], [B,A,…]
+        if self.act_names:
+            act = jax.vmap(lambda p, crow: jax.vmap(
+                lambda c: self._act_ok(p, c))(crow))(sv, cand)
+            ok = ok & act
+        # fmask carries both the live-row bound and the CONSTRAINT
+        # prune-not-expand mask (SURVEY §2.8)
+        valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
+                 carry["n_front"]) & fmask
         okf = (ok & valid[:, None]).reshape(N)
         n_gen = carry["n_gen"] + okf.sum(dtype=jnp.int32)
 
-        kws = tuple(jnp.where(okf, fp[..., w].reshape(N), U32MAX)
-                    for w in range(W))
+        # compact enabled lanes into FCAP (ascending lane index =
+        # the oracle's successor enumeration order)
         idx = jnp.arange(N, dtype=jnp.int32)
-        sorted_ops = lax.sort(kws + (idx,), num_keys=W, is_stable=True)
-        sk, sidx = sorted_ops[:W], sorted_ops[W]
-        # first of each equal-key run; stability => smallest original
-        # index survives (the oracle's first-seen rule)
-        diff = jnp.zeros(N, bool).at[0].set(True)
+        epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1,
+                         FCAP)                           # OOB drops
+        n_e = okf.sum(dtype=jnp.int32)
+        fovf = carry["fovf"] | (n_e > FCAP)
+        eidx = lax.optimization_barrier(
+            jnp.full((FCAP,), N, jnp.int32).at[epos].set(
+                idx, mode="drop"))                       # slot -> lane
+        elive = jnp.arange(FCAP, dtype=jnp.int32) < n_e
+        take = jnp.clip(eidx, 0, N - 1)
+        cand_c = lax.optimization_barrier(
+            {k: v.reshape((N,) + v.shape[2:])[take]
+             for k, v in cand.items()})                  # [FCAP, …]
+
+        # fingerprint only the compacted candidates
+        fp = lax.optimization_barrier(
+            jax.vmap(self.fpr.fingerprint)(cand_c))      # [FCAP, W]
+        kws = tuple(jnp.where(elive, fp[:, w], U32MAX)
+                    for w in range(W))
+        slot = jnp.arange(FCAP, dtype=jnp.int32)
+        sorted_ops = lax.optimization_barrier(
+            lax.sort(kws + (slot,), num_keys=W + 1))
+        sk, sslot = sorted_ops[:W], sorted_ops[W]
+        # first of each equal-key run = smallest slot (slot is the
+        # final sort key), i.e. the oracle's first-seen survivor
+        diff = jnp.zeros(FCAP, bool).at[0].set(True)
         for w in range(W):
             diff = diff | jnp.concatenate(
                 [jnp.ones(1, bool), sk[w][1:] != sk[w][:-1]])
-        is_sent = jnp.ones(N, bool)
+        is_sent = jnp.ones(FCAP, bool)
         for w in range(W):
             is_sent = is_sent & (sk[w] == U32MAX)
         surv = diff & ~is_sent
+        # membership probes against the visited set and the level set
         surv = surv & ~self._member(carry["vis"], sk)
         surv = surv & ~self._member(carry["lvlk"], sk)
 
-        fresh = jnp.zeros(N, bool).at[sidx].set(surv)   # original order
-        offs = jnp.cumsum(fresh.astype(jnp.int32)) - 1
-        pos = jnp.where(fresh, carry["n_lvl"] + offs, LCAP)   # OOB drops
-        n_fresh = fresh.sum(dtype=jnp.int32)
-        ovf = carry["ovf"] | (carry["n_lvl"] + n_fresh > LCAP)
+        surv = surv & ~self._member(carry["ltail"], sk)
 
-        lvl = {k: v.at[pos].set(cand[k].reshape((N,) + v.shape[1:]),
-                                mode="drop")
-               for k, v in carry["lvl"].items()}
-        lpar = carry["lpar"].at[pos].set(pgids[idx // A], mode="drop")
-        llane = carry["llane"].at[pos].set(idx % A, mode="drop")
-        ins = tuple(jnp.where(surv, sk[w], U32MAX) for w in range(W))
-        lvlk = self._sorted_insert(carry["lvlk"], ins, LCAP)
+        fresh = jnp.zeros(FCAP, bool).at[sslot].set(surv)  # slot order
+        n_fresh = fresh.sum(dtype=jnp.int32)
+        lpos = jnp.where(fresh,
+                         jnp.cumsum(fresh.astype(jnp.int32)) - 1, FCAP)
+        lidx, lkey = lax.optimization_barrier((
+            jnp.zeros((FCAP,), jnp.int32).at[lpos].set(
+                slot, mode="drop"),                      # out slot -> slot
+            tuple(jnp.full((FCAP,), U32MAX).at[lpos].set(
+                kws[w], mode="drop") for w in range(W))))
+
+        # contiguous append at n_lvl: gather FCAP rows, one
+        # dynamic_update_slice per array.  Rows past n_fresh are
+        # garbage but live beyond the new n_lvl, so later chunks
+        # overwrite them and finalize masks them by n_lvl.  The start
+        # clamp only engages when the level has overflowed, in which
+        # case ovf forces a replay anyway.
+        start = jnp.minimum(carry["n_lvl"], LCAP - FCAP)
+        ovf = carry["ovf"] | (carry["n_lvl"] + n_fresh > LCAP - FCAP)
+        lane = take[lidx]                                # original lane id
+        lvl = {k: lax.dynamic_update_slice_in_dim(
+            v, cand_c[k][lidx], start, 0)
+            for k, v in carry["lvl"].items()}
+        # parent global ids are arithmetic: frontier row r has id
+        # pg_off + r (the frontier IS the previous level, uncompacted)
+        lpar = lax.dynamic_update_slice_in_dim(
+            carry["lpar"], carry["pg_off"] + base + lane // A, start, 0)
+        llane = lax.dynamic_update_slice_in_dim(
+            carry["llane"], lane % A, start, 0)
+        # two-tier level key set (LSM-style): fresh keys merge into the
+        # small sorted tail each chunk (O(TCAP)); the tail spills into
+        # the big sorted run only when nearly full, so the O(LCAP)
+        # merge is amortized over many chunks instead of paid per chunk
+        TCAP = carry["ltail"][0].shape[0]
+        spill = carry["n_tail"] + n_fresh > TCAP
+
+        def do_spill(ops):
+            lvlk, ltail = ops
+            return (self._sorted_insert(lvlk, ltail, LCAP),
+                    tuple(jnp.full((TCAP,), U32MAX)
+                          for _ in range(W)))
+
+        def no_spill(ops):
+            return ops
+
+        lvlk, ltail = lax.cond(spill, do_spill, no_spill,
+                               (carry["lvlk"], carry["ltail"]))
+        n_tail = jnp.where(spill, 0, carry["n_tail"]) + n_fresh
+        ltail = self._sorted_insert(ltail, lkey, TCAP)
         return dict(carry, lvl=lvl, lpar=lpar, llane=llane, lvlk=lvlk,
-                    n_lvl=jnp.minimum(carry["n_lvl"] + n_fresh, LCAP),
-                    n_gen=n_gen, ovf=ovf)
+                    ltail=ltail, n_tail=n_tail,
+                    n_lvl=jnp.minimum(carry["n_lvl"] + n_fresh,
+                                      LCAP - FCAP),
+                    n_gen=n_gen, ovf=ovf, fovf=fovf,
+                    base=base + B)
 
     # ------------------------------------------------------------------
     # per-level finalize: invariants/constraints on the new states,
     # next-frontier compaction, visited merge — one device call
     # ------------------------------------------------------------------
 
-    def _finalize_impl(self, carry, g_off):
+    def _finalize_impl(self, carry):
+        """Level finalize.  Returns (carry', outputs) where
+        outputs["scal"] packs every per-level scalar the host needs —
+        [n_lvl, n_viol, faults, n_front, ovf, fovf, n_gen] — into ONE
+        int32 array so the level costs a single device→host round trip
+        (the tunneled-TPU transfer latency is ~100ms; it used to be
+        paid 5× per level).  When a chunk overflowed a buffer (ovf /
+        fovf), the commit branch is skipped on device: the visited set
+        and frontier stay untouched and the level buffer resets, so the
+        host can grow capacities and replay the level exactly."""
         LCAP = carry["lpar"].shape[0]
         VCAP = carry["vis"][0].shape[0]
         n_lvl = carry["n_lvl"]
+        g_off = carry["g_off"]
+        bad = carry["ovf"] | carry["fovf"]
         validrow = jnp.arange(LCAP, dtype=jnp.int32) < n_lvl
-        inv, con = self._phase2_impl(carry["lvl"])
+        # barrier for the same reason as the chunk step: stop XLA from
+        # rematerializing the predicate graphs into each consumer
+        inv, con = lax.optimization_barrier(
+            self._phase2_impl(carry["lvl"]))
         inv_ok = inv | ~validrow[:, None] if self.inv_names else inv
         n_viol = (~inv_ok).sum(dtype=jnp.int32)
         faults = ((carry["lvl"]["ctr"][:, C_OVERFLOW] > 0) &
                   validrow).sum(dtype=jnp.int32)
-        # CONSTRAINT = checked but not expanded (SURVEY §2.8)
-        expand_mask = con & validrow
-        fpos = jnp.where(expand_mask,
-                         jnp.cumsum(expand_mask.astype(jnp.int32)) - 1,
-                         LCAP)
-        front = {k: v.at[fpos].set(carry["lvl"][k], mode="drop")
-                 for k, v in carry["front"].items()}
-        gids = carry["gids"].at[fpos].set(
-            g_off + jnp.arange(LCAP, dtype=jnp.int32), mode="drop")
-        n_front = expand_mask.sum(dtype=jnp.int32)
-        vis = self._sorted_insert(carry["vis"], carry["lvlk"], VCAP)
+
+        def commit(carry):
+            # the level buffer BECOMES the frontier (pointer swap, free
+            # under donation); constraint-pruned rows stay in place and
+            # are masked out of expansion by fmask (prune-not-expand,
+            # SURVEY §2.8) so no LCAP-wide compaction gather is needed
+            fmask = con & validrow
+            vis = self._sorted_insert(
+                carry["vis"],
+                tuple(jnp.concatenate([carry["lvlk"][w],
+                                       carry["ltail"][w]])
+                      for w in range(self.W)),
+                VCAP)
+            return (carry["lvl"], carry["front"], fmask, n_lvl,
+                    vis, g_off, g_off + n_lvl)
+
+        def abandon(carry):
+            # overflow: leave frontier/visited intact for the replay
+            return (carry["front"], carry["lvl"], carry["fmask"],
+                    carry["n_front"], carry["vis"], carry["pg_off"],
+                    g_off)
+
+        front, lvl, fmask, n_front, vis, pg_off, g_next = lax.cond(
+            bad, abandon, commit, carry)
         lvlk = tuple(jnp.full((LCAP,), U32MAX) for _ in range(self.W))
-        new_carry = dict(carry, vis=vis, lvlk=lvlk, front=front,
-                         gids=gids, n_front=n_front,
-                         n_lvl=jnp.int32(0), ovf=jnp.bool_(False))
-        return new_carry, dict(inv_ok=inv_ok, n_viol=n_viol,
-                               faults=faults, n_front=n_front,
-                               n_lvl=n_lvl)
+        ltail = tuple(jnp.full((carry["ltail"][0].shape[0],), U32MAX)
+                      for _ in range(self.W))
+        n_expand = (con & validrow).sum(dtype=jnp.int32)
+        scal = jnp.stack([
+            n_lvl, n_viol, faults, n_front,
+            carry["ovf"].astype(jnp.int32), carry["fovf"].astype(jnp.int32),
+            carry["n_gen"], n_expand])
+        new_carry = dict(carry, vis=vis, lvlk=lvlk, ltail=ltail,
+                         n_tail=jnp.int32(0), front=front, lvl=lvl,
+                         fmask=fmask, n_front=n_front,
+                         n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
+                         ovf=jnp.bool_(False), fovf=jnp.bool_(False),
+                         base=jnp.int32(0), pg_off=pg_off, g_off=g_next)
+        return new_carry, dict(inv_ok=inv_ok, scal=scal)
 
     # ------------------------------------------------------------------
 
-    def _fresh_carry(self, lcap: int, vcap: int):
+    def _fresh_carry(self, lcap: int, vcap: int, fcap: Optional[int] = None):
+        fcap = fcap if fcap is not None else self.FCAP
         one = encode(self.lay, *init_state(self.cfg))
         zeros = {k: jnp.zeros((lcap,) + v.shape, dtype=v.dtype)
                  for k, v in one.items()}
         sent = tuple(jnp.full((lcap,), U32MAX) for _ in range(self.W))
+        tcap = min(8 * fcap, lcap)
         return dict(
             vis=tuple(jnp.full((vcap,), U32MAX) for _ in range(self.W)),
             lvlk=sent,
+            ltail=tuple(jnp.full((tcap,), U32MAX) for _ in range(self.W)),
+            n_tail=jnp.int32(0),
             lvl=zeros,
             lpar=jnp.full((lcap,), -1, jnp.int32),
             llane=jnp.full((lcap,), -1, jnp.int32),
+            cidx=jnp.zeros((fcap,), jnp.int32),   # chunk-compaction scratch
             n_lvl=jnp.int32(0),
             n_gen=jnp.int32(0),
+            base=jnp.int32(0),      # chunk cursor within the frontier
+            g_off=jnp.int32(0),     # global state-id offset (this level)
+            pg_off=jnp.int32(0),    # global state-id offset (frontier)
             ovf=jnp.bool_(False),
+            fovf=jnp.bool_(False),
             front={k: jnp.zeros_like(v) for k, v in zeros.items()},
-            gids=jnp.full((lcap,), -1, jnp.int32),
+            fmask=jnp.zeros((lcap,), bool),
             n_front=jnp.int32(0),
         )
 
@@ -341,15 +506,17 @@ class Engine:
         the frontier survive; the level buffer is reset — callers replay
         the level)."""
         old_lcap = carry["lpar"].shape[0]
-        new = self._fresh_carry(lcap, vcap)
+        new = self._fresh_carry(lcap, vcap, self.FCAP)
         new["vis"] = self._grow_vis(carry, vcap)["vis"]
         pad = lcap - old_lcap
         new["front"] = {k: jnp.concatenate(
             [carry["front"][k], jnp.zeros((pad,) + v.shape[1:], v.dtype)])
             for k, v in carry["front"].items()}
-        new["gids"] = jnp.concatenate(
-            [carry["gids"], jnp.full((pad,), -1, jnp.int32)])
+        new["fmask"] = jnp.concatenate(
+            [carry["fmask"], jnp.zeros((pad,), bool)])
         new["n_front"] = carry["n_front"]
+        new["g_off"] = carry["g_off"]
+        new["pg_off"] = carry["pg_off"]
         # n_gen stays 0: the caller replays the whole level from the
         # intact frontier, so keeping the partial count would double it
         return new
@@ -373,7 +540,7 @@ class Engine:
             {k: v[None] for k, v in encode(lay, *s).items()}
             for s in init_list])
         rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
-        root_fp = np.asarray(jax.vmap(self.fpr.fingerprint)(rootsb))
+        root_fp = np.asarray(self._rootfp_jit(rootsb))
         root_keys = fp_key(root_fp)
         _uniq, first_idx = np.unique(root_keys, return_index=True)
         first_idx.sort()
@@ -386,7 +553,7 @@ class Engine:
         self._parents: List[np.ndarray] = []
         self._lanes: List[np.ndarray] = []
 
-        while self.LCAP < 2 * n_roots:
+        while self.LCAP - self.FCAP < 2 * n_roots:
             self.LCAP *= 2
         carry = self._fresh_carry(self.LCAP, self.VCAP)
         # roots enter through the same admit path as every level: place
@@ -410,33 +577,38 @@ class Engine:
         t_dev = 0.0
 
         def run_finalize(carry):
-            nonlocal n_vis
-            need = n_vis + int(np.asarray(carry["n_lvl"]))
+            # pessimistic growth: a level can add at most LCAP - FCAP
+            # keys, so growing on the bound needs no mid-level sync
+            need = n_vis + self.LCAP - self.FCAP
             if need > self.VCAP:
                 while self.VCAP < need:
-                    self.VCAP *= 2
+                    self.VCAP *= 4
                 carry = self._grow_vis(carry, self.VCAP)
-            return self._fin_jit(carry, jnp.int32(n_states))
+            carry, out = self._fin_jit(carry)
+            # the ONE per-level device->host sync
+            return carry, out, [int(x) for x in np.asarray(out["scal"])]
 
-        def harvest(carry, out):
+        def harvest(carry, out, scal):
             """Per-level host bookkeeping: counts, parents/lanes,
             violations, optional state store."""
             nonlocal n_states, n_vis
-            n_lvl = int(np.asarray(out["n_lvl"]))
+            n_lvl, n_viol, faults, n_front, _, _, n_genl, _ = scal
             res.distinct_states += n_lvl
-            res.overflow_faults += int(np.asarray(out["faults"]))
-            # slice on device, transfer only live rows
-            self._parents.append(np.asarray(carry["lpar"][:n_lvl]))
-            self._lanes.append(np.asarray(carry["llane"][:n_lvl]))
+            res.overflow_faults += faults
+            res.generated_states += n_genl
             if self.store_states:
+                # after finalize the level's rows live in front (the
+                # buffers swap); they are only overwritten by the
+                # next-next level's chunk steps
+                self._parents.append(np.asarray(carry["lpar"][:n_lvl]))
+                self._lanes.append(np.asarray(carry["llane"][:n_lvl]))
                 self._states.append(
                     {k: np.asarray(v[:n_lvl])
-                     for k, v in carry["lvl"].items()})
-            n_viol = int(np.asarray(out["n_viol"]))
+                     for k, v in carry["front"].items()})
             if n_viol:
                 inv_ok = np.asarray(out["inv_ok"])[:n_lvl]
                 rows = {k: np.asarray(v)[:n_lvl]
-                        for k, v in carry["lvl"].items()}
+                        for k, v in carry["front"].items()}
                 for j, nm in enumerate(self.inv_names):
                     for s in np.nonzero(~inv_ok[:, j])[0]:
                         vsv, vh = decode(self.lay, _take(rows, s))
@@ -451,10 +623,10 @@ class Engine:
                 raise RuntimeError(
                     "state-id space exhausted (2^31 ids): run exceeds "
                     "the engine's int32 global-id width")
-            return int(np.asarray(out["n_front"]))
+            return n_front
 
-        carry, out = run_finalize(carry)
-        n_front = harvest(carry, out)
+        carry, out, scal = run_finalize(carry)
+        n_front = harvest(carry, out, scal)
         if stop_on_violation and res.violations:
             res.seconds = time.time() - t0
             return res
@@ -465,31 +637,47 @@ class Engine:
             t1 = time.time()
             while True:
                 n_chunks = (n_front + self.chunk - 1) // self.chunk
-                for c in range(n_chunks):
-                    carry = self._step_jit(carry, jnp.int32(c * self.chunk))
-                if not bool(np.asarray(carry["ovf"])):
+                for _ in range(n_chunks):
+                    carry = self._step_jit(carry)
+                carry, out, scal = run_finalize(carry)
+                ovf, fovf = bool(scal[4]), bool(scal[5])
+                if not (ovf or fovf):
                     break
-                # level buffer overflow: double LCAP and replay the
-                # level (visited is only merged at finalize, so replay
-                # from the intact frontier is exact)
-                self.LCAP *= 2
+                # buffer overflow: the finalize skipped its commit on
+                # device (frontier + visited intact), so grow and
+                # replay the level exactly.  Growth is 4x — each growth
+                # step recompiles the fused kernels, so fewer, larger
+                # steps.
+                if fovf:
+                    self.FCAP *= 4
+                if ovf or self.LCAP < 4 * self.FCAP:
+                    self.LCAP = self._round_cap(
+                        max((4 * self.LCAP) if ovf else self.LCAP,
+                            4 * self.FCAP))
                 if verbose:
-                    print(f"level {depth}: buffer overflow, growing "
-                          f"LCAP to {self.LCAP}")
+                    print(f"level {depth}: buffer overflow "
+                          f"({'level' if ovf else 'chunk'}), growing "
+                          f"LCAP={self.LCAP} FCAP={self.FCAP}")
                 carry = self._grow(carry, self.LCAP, self.VCAP)
-            carry, out = run_finalize(carry)
-            res.generated_states += int(np.asarray(carry["n_gen"]))
-            carry["n_gen"] = jnp.int32(0)
-            n_front = harvest(carry, out)
+            n_front = harvest(carry, out, scal)
+            if scal[0] == 0 and scal[6] == 0:
+                # the frontier had only constraint-pruned rows: nothing
+                # was even generated, so this is not a BFS level (the
+                # oracle's frontier excludes pruned rows and would not
+                # have run it).  An all-duplicates level (n_gen > 0)
+                # DOES count, matching the oracle.
+                depth -= 1
+            else:
+                # post-constraint frontier size, the oracle's metric
+                res.level_sizes.append(scal[7])
             t_dev += time.time() - t1
-            res.level_sizes.append(n_front)
             if stop_on_violation and res.violations:
                 break
             if verbose:
-                n_lvl = int(np.asarray(out["n_lvl"]))
-                print(f"depth {depth}: +{n_lvl} states "
+                print(f"depth {depth}: +{scal[0]} states "
                       f"(total {res.distinct_states}), "
-                      f"frontier {n_front}")
+                      f"frontier {n_front}, "
+                      f"{n_chunks} chunks in {time.time() - t1:.2f}s")
         res.depth = depth
         res.seconds = time.time() - t0
         res.phase_seconds["device_levels"] = t_dev

@@ -1,0 +1,55 @@
+"""Profile the TPU engine on the bench config: where does wall time go?
+
+Times jit compilation vs steady-state chunk steps vs finalize, and counts
+recompiles caused by LCAP/VCAP growth.
+"""
+import sys
+import time
+import os, sys; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tla_tpu.cfg.parser import load_model
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.engine.bfs import Engine
+
+import jax
+import jax.numpy as jnp
+
+cfg = load_model("/root/reference/tlc_membership/raft.cfg",
+                 bounds=Bounds.make(max_log_length=3, max_timeouts=2,
+                                    max_client_requests=3))
+cfg = cfg.with_(invariants=("ElectionSafety",))
+
+chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+lcap = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 14
+vcap = int(sys.argv[3]) if len(sys.argv) > 3 else 1 << 17
+eng = Engine(cfg, chunk=chunk, store_states=False, lcap=lcap, vcap=vcap)
+print(f"lanes={eng.A} chunk={chunk} N={chunk*eng.A} lcap={lcap} vcap={vcap}")
+
+# --- compile timings -------------------------------------------------
+carry = eng._fresh_carry(eng.LCAP, eng.VCAP)
+t0 = time.time(); c2 = eng._step_jit(carry)
+jax.block_until_ready(c2["n_lvl"]); print(f"step compile+run1: {time.time()-t0:.1f}s")
+t0 = time.time(); c3, out = eng._fin_jit(c2)
+jax.block_until_ready(out["scal"]); print(f"finalize compile+run1: {time.time()-t0:.1f}s")
+
+# steady state: time 10 chunk steps + 1 finalize (block_until_ready is
+# unreliable through the axon tunnel: sync with a real transfer)
+import numpy as _np
+t0 = time.time()
+for _ in range(10):
+    c3 = eng._step_jit(c3)
+_ = int(_np.asarray(c3["n_lvl"]))
+dt = (time.time()-t0)/10
+print(f"steady chunk step: {dt*1000:.1f} ms -> {chunk/dt:.0f} parent-states/s "
+      f"({chunk*eng.A/dt:.0f} cand/s)")
+t0 = time.time(); c4, out = eng._fin_jit(c3)
+_ = _np.asarray(out["scal"])
+print(f"steady finalize: {(time.time()-t0)*1000:.1f} ms")
+
+# --- full run with growth logging -----------------------------------
+eng2 = Engine(cfg, chunk=chunk, store_states=False, lcap=lcap, vcap=vcap)
+t0 = time.time()
+r = eng2.check(max_states=150_000, verbose=True)
+print(f"full: {r.distinct_states} states depth {r.depth} in "
+      f"{time.time()-t0:.1f}s -> {r.states_per_sec:.0f}/s  "
+      f"final LCAP={eng2.LCAP} VCAP={eng2.VCAP}")
