@@ -52,6 +52,14 @@ def main():
                got.depth == want.depth and
                got.generated_states == want.generated_states and
                len(got.violations) == len(want.violations))
+    if not gate_ok:
+        print(json.dumps({
+            "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
+            "value": 0.0, "unit": "states/sec", "vs_baseline": 0.0,
+            "detail": {"correctness_gate": False,
+                       "micro_engine": int(got.distinct_states),
+                       "micro_oracle": int(want.distinct_states)}}))
+        return
 
     # -- metric config #2 ----------------------------------------------
     # MaxTerm=3 <=> max_timeouts=2 (MaxTerms = MaxTimeouts+1, raft.tla:27)
@@ -63,8 +71,8 @@ def main():
     budget = int(float(sys.argv[1])) if len(sys.argv) > 1 else BUDGET
 
     # -- CPU baseline: the native multi-threaded checker ----------------
-    nat = native.check(cfg, threads=os.cpu_count() or 8,
-                       max_states=budget)
+    threads = os.cpu_count() or 8
+    nat = native.check(cfg, threads=threads, max_states=budget)
     nat_rate = nat.states_per_sec
 
     # -- TPU engine, same budget ----------------------------------------
@@ -95,7 +103,7 @@ def main():
             "overflow_faults": int(r.overflow_faults),
             "baseline_native_states_per_sec": round(nat_rate, 1),
             "baseline_native_seconds": round(nat.seconds, 2),
-            "baseline_native_threads": os.cpu_count() or 8,
+            "baseline_native_threads": threads,
             "correctness_gate": bool(gate_ok),
             "counts_match_native": bool(count_ok),
             "exhausted": bool(r.distinct_states < budget),
