@@ -1,76 +1,575 @@
-"""Multi-device frontier sharding (SURVEY §2.14).
+"""Multi-device BFS: frontier data parallelism + fingerprint-ownership
+partitioning (SURVEY §2.14).
 
-The reference's engine-level parallelism is TLC's multi-worker BFS over
-shared memory (`-workers 8`); the TPU-native counterpart is **data
-parallelism over the frontier axis**: the per-level candidate expansion
-(engine/bfs phase 1: expand + fingerprint) is compiled once over a
-1-D ``jax.sharding.Mesh`` with the batch axis sharded, so each device
-expands its slice of the frontier.  A ``jax.lax.all_gather`` over the
-mesh axis exchanges the per-device fingerprint blocks (the ICI ride that
-replaces TLC's shared fingerprint table) so every device — and the host
-after one transfer — sees the full candidate fingerprint set.
+The reference's engine-level parallelism is TLC's multi-worker BFS with
+a partitioned fingerprint table (`-workers 8`).  The TPU-native
+counterpart implemented here:
 
-Fingerprint-ownership partitioning (hash-prefix → device, all-to-all
-exchange, device-resident visited set) is the planned next step; the
-host-side sorted set remains the dedup authority for now (SURVEY §7.2
-L6 lands in stages).
+- the frontier, level buffer, parent arrays and the visited/level key
+  sets all carry a leading device axis and live sharded over a 1-D
+  ``jax.sharding.Mesh`` (``shard_map`` over axis "d");
+- each device expands its frontier shard and fingerprints its enabled
+  candidates (compute data parallelism);
+- every candidate is then routed to its OWNER device — owner = low
+  bits of the fingerprint — via ``jax.lax.all_to_all`` over ICI; the
+  owner probes its visited/level shards, dedups, and appends fresh
+  states to its level shard.  The dedup authority therefore lives on
+  device and is partitioned by hash, exactly like TLC's worker-local
+  fingerprint table partitions, with the all-to-all exchange riding
+  ICI instead of shared memory;
+- because ownership is hash-uniform, the next frontier (the level
+  buffer, swapped in place) is automatically load-balanced.
+
+Global state ids are assigned device-major per level: device d's rows
+get ids ``g_base + prefix[d] + row`` where ``prefix`` is the exclusive
+cumsum of the per-device level counts (computed on device with an
+``all_gather``).  The host reads ONE packed per-level scalar matrix.
+
+Determinism caveat (shared with TLC's multi-worker mode): when two
+candidates have equal VIEW fingerprints but different non-VIEW history
+counters, WHICH concrete state survives depends on arrival order.
+Under ``VIEW``-insensitive constraint sets the reachable set is
+unaffected; with counter-dependent constraints (BoundedTimeouts etc.)
+multi-worker TLC has the same nondeterminism.  The sharded differential
+test therefore runs a counter-free constraint set.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+import time
+from functools import partial
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:                                    # jax >= 0.6
+    from jax import shard_map
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+except ImportError:                     # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
 from ..config import ModelConfig
-from ..engine.bfs import Engine
+from ..engine.bfs import (CheckResult, Engine, U32MAX, Violation, _cat,
+                          _take)
+from ..models.raft import init_state
+from ..ops.codec import C_OVERFLOW, decode, encode
 
 
 class ShardedEngine(Engine):
-    """Engine whose phase-1 (expand + fingerprint) runs sharded over a
-    device mesh.  chunk must be a multiple of the mesh size."""
+    """Engine whose full BFS runs sharded over a device mesh with
+    hash-ownership-partitioned visited/level key sets.
+
+    chunk — GLOBAL frontier states expanded per step (chunk/D per
+    device); must be a multiple of the mesh size."""
 
     def __init__(self, cfg: ModelConfig, devices=None, chunk: int = 512,
-                 store_states: bool = True):
+                 store_states: bool = True,
+                 lcap: int = 1 << 14, vcap: int = 1 << 17,
+                 fcap: Optional[int] = None, scap: Optional[int] = None):
         devices = devices if devices is not None else jax.devices()
-        self.mesh = Mesh(np.array(devices), axis_names=("frontier",))
-        self.n_dev = len(devices)
-        assert chunk % self.n_dev == 0, \
-            f"chunk {chunk} not divisible by {self.n_dev} devices"
-        super().__init__(cfg, chunk=chunk, store_states=store_states)
-        shard = NamedSharding(self.mesh, P("frontier"))
-        self._shard = shard
-        self._phase1 = jax.jit(
-            self._phase1_sharded,
-            in_shardings=({k: shard for k in self._state_keys()},),
-            out_shardings=(shard, {k: shard for k in self._state_keys()},
-                           shard))
+        self.mesh = Mesh(np.array(devices), axis_names=("d",))
+        self.D = len(devices)
+        assert chunk % self.D == 0, \
+            f"chunk {chunk} not divisible by {self.D} devices"
+        self.BL = chunk // self.D              # frontier rows per device
+        super().__init__(cfg, chunk=chunk, store_states=store_states,
+                         lcap=lcap, vcap=vcap, fcap=fcap)
+        # per-device capacities
+        self.FC = max(256, (self.FCAP + self.D - 1) // self.D)
+        self.VB = max(1 << 12, vcap // self.D)
+        # send capacity per (src, dst) pair; hash-uniform routing puts
+        # ~FC/D candidates per destination — 4x headroom, growable
+        self.SC = int(scap) if scap else max(256, 4 * self.FC // self.D)
+        # the level shard must hold the D*SC receive window on top of
+        # its usable capacity
+        self.LB = self._round_lb(max(lcap // self.D, 4 * self.FC,
+                                     2 * self.D * self.SC))
+        self._set_tb()
+        self._step_jit = jax.jit(self._sharded_step_call,
+                                 donate_argnums=0)
+        self._fin_jit = jax.jit(self._sharded_fin_call, donate_argnums=0)
 
-    def _state_keys(self):
-        from ..ops.codec import ALL_KEYS
-        return ALL_KEYS
+    def _round_lb(self, n: int) -> int:
+        b = self.BL
+        return ((int(n) + b - 1) // b) * b
 
-    def _phase1_sharded(self, svb):
-        ok, cand, fp = self._phase1_impl(svb)
-        return ok, cand, fp
+    def _set_tb(self):
+        # the tail must hold a full per-step receive window (n_fresh
+        # can reach M = D*SC); a too-small tail would silently drop
+        # keys in _sorted_insert and re-admit duplicate states
+        self.TB = min(max(8 * self.FC, self.D * self.SC), self.LB)
+
+    # -----------------------------------------------------------------
+    def _sharded_step_call(self, carry):
+        specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
+        return _shard_map(self._shard_step, self.mesh,
+                          (specs,), specs)(carry)
+
+    def _sharded_fin_call(self, carry):
+        specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
+        out_specs = (specs, dict(inv_ok=P("d"), scal=P("d")))
+        return _shard_map(self._shard_finalize, self.mesh,
+                          (specs,), out_specs)(carry)
+
+    # -----------------------------------------------------------------
+    # per-device chunk step (runs inside shard_map; leading axis of
+    # every leaf is the local shard, size 1 in the device dimension)
+    # -----------------------------------------------------------------
+
+    def _shard_step(self, carry):
+        c = jax.tree_util.tree_map(lambda x: x[0], carry)
+        c = self._local_step(c)
+        return jax.tree_util.tree_map(lambda x: x[None], c)
+
+    def _local_step(self, c):
+        B, A, W, D = self.BL, self.A, self.W, self.D
+        # capacities derive from carry shapes so growth always retraces
+        FC = c["cidx"].shape[0]
+        SC = c["sscr"].shape[0]
+        LB = c["fmask"].shape[0]
+        N = B * A
+        M = D * SC                     # received candidates per step
+        base = c["base"]
+        sv = {k: lax.dynamic_slice_in_dim(v, base, B)
+              for k, v in c["front"].items()}
+        fmask = lax.dynamic_slice_in_dim(c["fmask"], base, B)
+        ok, cand = lax.optimization_barrier(
+            self.expander._expand_impl(sv))
+        if self.act_names:
+            act = jax.vmap(lambda p, crow: jax.vmap(
+                lambda cc: self._act_ok(p, cc))(crow))(sv, cand)
+            ok = ok & act
+        valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
+                 c["n_front"]) & fmask
+        okf = (ok & valid[:, None]).reshape(N)
+        n_gen = c["n_gen"] + okf.sum(dtype=jnp.int32)
+
+        # compact enabled lanes, fingerprint them
+        idx = jnp.arange(N, dtype=jnp.int32)
+        epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1, FC)
+        n_e = okf.sum(dtype=jnp.int32)
+        fovf = c["fovf"] | (n_e > FC)
+        eidx = lax.optimization_barrier(
+            jnp.full((FC,), N, jnp.int32).at[epos].set(idx, mode="drop"))
+        elive = jnp.arange(FC, dtype=jnp.int32) < n_e
+        take = jnp.clip(eidx, 0, N - 1)
+        cand_c = lax.optimization_barrier(
+            {k: v.reshape((N,) + v.shape[2:])[take]
+             for k, v in cand.items()})
+        fp = lax.optimization_barrier(
+            jax.vmap(self.fpr.fingerprint)(cand_c))        # [FC, W]
+        pgid = c["pg_off"] + base + take // A
+        lane = take % A
+
+        # ---- route to owner device (hash-ownership, SURVEY §2.14) ----
+        owner = jnp.where(elive, (fp[:, W - 1] % D).astype(jnp.int32), D)
+        slot = jnp.arange(FC, dtype=jnp.int32)
+        o_s, slot_s = lax.optimization_barrier(
+            lax.sort((owner, slot), num_keys=2))
+        counts = jnp.sum(o_s[None, :] == jnp.arange(D)[:, None],
+                         axis=1)                            # [D]
+        starts = jnp.cumsum(counts) - counts
+        rank = jnp.arange(FC, dtype=jnp.int32) - \
+            starts[jnp.clip(o_s, 0, D - 1)]
+        live_s = o_s < D
+        sovf = c["sovf"] | jnp.any(live_s & (rank >= SC))
+        dest = jnp.where(live_s & (rank < SC),
+                         o_s * SC + jnp.clip(rank, 0, SC - 1), M)
+        # inverse map: send slot -> local candidate slot
+        sidx = lax.optimization_barrier(
+            jnp.full((M,), FC, jnp.int32).at[dest].set(
+                slot_s, mode="drop"))
+        sfill = jnp.zeros((M,), bool).at[dest].set(live_s, mode="drop")
+        stake = jnp.clip(sidx, 0, FC - 1)
+        send_key = tuple(jnp.where(sfill, fp[stake, w], U32MAX)
+                         for w in range(W))
+        send_row = {k: v[stake] for k, v in cand_c.items()}
+        send_pgid = jnp.where(sfill, pgid[stake], -1)
+        send_lane = jnp.where(sfill, lane[stake], -1)
+        (send_key, send_row, send_pgid, send_lane) = \
+            lax.optimization_barrier(
+                (send_key, send_row, send_pgid, send_lane))
+
+        a2a = partial(lax.all_to_all, axis_name="d", split_axis=0,
+                      concat_axis=0, tiled=True)
+        recv_key = tuple(a2a(kw) for kw in send_key)        # [M] each
+        recv_row = {k: a2a(v) for k, v in send_row.items()}
+        recv_pgid = a2a(send_pgid)
+        recv_lane = a2a(send_lane)
+
+        # ---- owner-side dedup (first-seen in arrival-slot order) ----
+        ridx = jnp.arange(M, dtype=jnp.int32)
+        sorted_ops = lax.optimization_barrier(
+            lax.sort(recv_key + (ridx,), num_keys=W + 1))
+        sk, srid = sorted_ops[:W], sorted_ops[W]
+        diff = jnp.zeros(M, bool).at[0].set(True)
+        for w in range(W):
+            diff = diff | jnp.concatenate(
+                [jnp.ones(1, bool), sk[w][1:] != sk[w][:-1]])
+        is_sent = jnp.ones(M, bool)
+        for w in range(W):
+            is_sent = is_sent & (sk[w] == U32MAX)
+        surv = diff & ~is_sent
+        surv = surv & ~self._member(c["vis"], sk)
+        surv = surv & ~self._member(c["lvlk"], sk)
+        surv = surv & ~self._member(c["ltail"], sk)
+
+        fresh = jnp.zeros(M, bool).at[srid].set(surv)
+        n_fresh = fresh.sum(dtype=jnp.int32)
+        lpos = jnp.where(fresh,
+                         jnp.cumsum(fresh.astype(jnp.int32)) - 1, M)
+        lidx, lkey = lax.optimization_barrier((
+            jnp.zeros((M,), jnp.int32).at[lpos].set(ridx, mode="drop"),
+            tuple(jnp.full((M,), U32MAX).at[lpos].set(
+                recv_key[w], mode="drop") for w in range(W))))
+
+        start = jnp.minimum(c["n_lvl"], LB - M)
+        ovf = c["ovf"] | (c["n_lvl"] + n_fresh > LB - M)
+        lvl = {k: lax.dynamic_update_slice_in_dim(
+            v, recv_row[k][lidx], start, 0)
+            for k, v in c["lvl"].items()}
+        lpar = lax.dynamic_update_slice_in_dim(
+            c["lpar"], recv_pgid[lidx], start, 0)
+        llane = lax.dynamic_update_slice_in_dim(
+            c["llane"], recv_lane[lidx], start, 0)
+
+        TB = c["ltail"][0].shape[0]
+        ovf = ovf | (n_fresh > TB)     # belt: TB >= M should hold
+        spill = c["n_tail"] + n_fresh > TB
+
+        def do_spill(ops):
+            lvlk, ltail = ops
+            return (self._sorted_insert(lvlk, ltail, LB),
+                    tuple(jnp.full((TB,), U32MAX) for _ in range(W)))
+
+        lvlk, ltail = lax.cond(spill, do_spill, lambda o: o,
+                               (c["lvlk"], c["ltail"]))
+        n_tail = jnp.where(spill, 0, c["n_tail"]) + n_fresh
+        ltail = self._sorted_insert(ltail, lkey, TB)
+        return dict(c, lvl=lvl, lpar=lpar, llane=llane, lvlk=lvlk,
+                    ltail=ltail, n_tail=n_tail,
+                    n_lvl=jnp.minimum(c["n_lvl"] + n_fresh, LB - M),
+                    n_gen=n_gen, ovf=ovf, fovf=fovf, sovf=sovf,
+                    base=base + B)
+
+    # -----------------------------------------------------------------
+
+    def _shard_finalize(self, carry):
+        c = jax.tree_util.tree_map(lambda x: x[0], carry)
+        LB = c["fmask"].shape[0]
+        VB = c["vis"][0].shape[0]
+        n_lvl = c["n_lvl"]
+        bad_local = c["ovf"] | c["fovf"] | c["sovf"]
+        # any device overflowing aborts the level everywhere
+        bad = jax.lax.all_gather(bad_local, "d").any()
+        validrow = jnp.arange(LB, dtype=jnp.int32) < n_lvl
+        inv, con = lax.optimization_barrier(
+            self._phase2_impl(c["lvl"]))
+        inv_ok = inv | ~validrow[:, None] if self.inv_names else inv
+        n_viol = (~inv_ok).sum(dtype=jnp.int32)
+        faults = ((c["lvl"]["ctr"][:, C_OVERFLOW] > 0) &
+                  validrow).sum(dtype=jnp.int32)
+
+        # device-major global ids for this level
+        nl_vec = jax.lax.all_gather(n_lvl, "d")             # [D]
+        prefix = jnp.cumsum(nl_vec) - nl_vec
+        d_idx = jax.lax.axis_index("d")
+        total = nl_vec.sum()
+
+        def commit(c):
+            fmask = con & validrow
+            ins = tuple(jnp.concatenate([c["lvlk"][w], c["ltail"][w]])
+                        for w in range(self.W))
+            vis = self._sorted_insert(c["vis"], ins, VB)
+            return (c["lvl"], c["front"], fmask, n_lvl, vis,
+                    c["g_off"] + prefix[d_idx], c["g_off"] + total)
+
+        def abandon(c):
+            return (c["front"], c["lvl"], c["fmask"], c["n_front"],
+                    c["vis"], c["pg_off"], c["g_off"])
+
+        front, lvl, fmask, n_front, vis, pg_off, g_next = lax.cond(
+            bad, abandon, commit, c)
+        lvlk = tuple(jnp.full((LB,), U32MAX) for _ in range(self.W))
+        ltail = tuple(jnp.full((c["ltail"][0].shape[0],), U32MAX)
+                      for _ in range(self.W))
+        scal = jnp.stack([
+            n_lvl, n_viol, faults, n_front,
+            c["ovf"].astype(jnp.int32), c["fovf"].astype(jnp.int32),
+            c["n_gen"], (con & validrow).sum(dtype=jnp.int32),
+            c["sovf"].astype(jnp.int32)])
+        new_c = dict(c, vis=vis, lvlk=lvlk, ltail=ltail,
+                     n_tail=jnp.int32(0), front=front, lvl=lvl,
+                     fmask=fmask, n_front=n_front,
+                     n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
+                     ovf=jnp.bool_(False), fovf=jnp.bool_(False),
+                     sovf=jnp.bool_(False),
+                     base=jnp.int32(0), pg_off=pg_off, g_off=g_next)
+        out = dict(inv_ok=inv_ok, scal=scal)
+        return (jax.tree_util.tree_map(lambda x: x[None], new_c),
+                jax.tree_util.tree_map(lambda x: x[None], out))
+
+    # -----------------------------------------------------------------
+
+    def _fresh_sharded_carry(self):
+        D, LB, VB, TB, FC = self.D, self.LB, self.VB, self.TB, self.FC
+        one = encode(self.lay, *init_state(self.cfg))
+        zeros = {k: jnp.zeros((D, LB) + v.shape, dtype=v.dtype)
+                 for k, v in one.items()}
+        return dict(
+            vis=tuple(jnp.full((D, VB), U32MAX) for _ in range(self.W)),
+            lvlk=tuple(jnp.full((D, LB), U32MAX) for _ in range(self.W)),
+            ltail=tuple(jnp.full((D, TB), U32MAX)
+                        for _ in range(self.W)),
+            n_tail=jnp.zeros((D,), jnp.int32),
+            lvl=zeros,
+            lpar=jnp.full((D, LB), -1, jnp.int32),
+            llane=jnp.full((D, LB), -1, jnp.int32),
+            cidx=jnp.zeros((D, FC), jnp.int32),
+            # shape anchor for SC: jit caches on input avals, and SC
+            # otherwise only shapes internal send/recv buffers — an SC
+            # growth would silently cache-hit the stale trace
+            sscr=jnp.zeros((D, self.SC), jnp.int32),
+            n_lvl=jnp.zeros((D,), jnp.int32),
+            n_gen=jnp.zeros((D,), jnp.int32),
+            base=jnp.zeros((D,), jnp.int32),
+            g_off=jnp.zeros((D,), jnp.int32),
+            pg_off=jnp.zeros((D,), jnp.int32),
+            ovf=jnp.zeros((D,), bool),
+            fovf=jnp.zeros((D,), bool),
+            sovf=jnp.zeros((D,), bool),
+            front={k: jnp.zeros_like(v) for k, v in zeros.items()},
+            fmask=jnp.zeros((D, LB), bool),
+            n_front=jnp.zeros((D,), jnp.int32),
+        )
+
+    # -----------------------------------------------------------------
+
+    def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
+              stop_on_violation: bool = False,
+              seed_states: Optional[List] = None,
+              verbose: bool = False) -> CheckResult:
+        t0 = time.time()
+        lay = self.lay
+        D, W, LB = self.D, self.W, self.LB
+        init_list = (seed_states if seed_states is not None
+                     else [init_state(self.cfg)])
+        init_arrs = _cat([
+            {k: np.asarray(v)[None] for k, v in s.items()}
+            if isinstance(s, dict) else
+            {k: v[None] for k, v in encode(lay, *s).items()}
+            for s in init_list])
+        rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
+        root_fp = np.asarray(self._rootfp_jit(rootsb)).astype(np.uint32)
+        # host-side dedup of seeds + ownership routing
+        keys = [tuple(int(root_fp[i, w]) for w in range(W))
+                for i in range(root_fp.shape[0])]
+        seen = {}
+        for i, k in enumerate(keys):
+            seen.setdefault(k, i)
+        per_dev: List[List[int]] = [[] for _ in range(D)]
+        for k, i in sorted(seen.items(), key=lambda kv: kv[1]):
+            per_dev[int(k[W - 1]) % D].append(i)
+        # grow the level shard until the most-loaded device's seeds fit
+        # with the receive-window margin (punctuated-search seed sets
+        # can be thousands of states, hash-skewed across devices)
+        max_seed = max(len(p) for p in per_dev)
+        while self.LB - self.D * self.SC < 2 * max_seed:
+            self.LB = self._round_lb(2 * self.LB)
+        self._set_tb()
+        LB = self.LB
+
+        res = CheckResult(distinct_states=0,
+                          generated_states=len(seen), depth=0)
+        self._states = []
+        self._parents = []
+        self._lanes = []
+
+        carry_np = jax.tree_util.tree_map(
+            lambda x: np.array(x), self._fresh_sharded_carry())
+        nl = np.zeros((D,), np.int32)
+        for d in range(D):
+            for r, i in enumerate(per_dev[d]):
+                for k in init_arrs:
+                    carry_np["lvl"][k][d, r] = init_arrs[k][i]
+                carry_np["lpar"][d, r] = -1
+                carry_np["llane"][d, r] = -1
+            nl[d] = len(per_dev[d])
+            rk = root_fp[per_dev[d]]                       # [n, W]
+            order = np.lexsort(tuple(rk[:, w]
+                                     for w in range(W - 1, -1, -1)))
+            for w in range(W):
+                col = np.full((LB,), 0xFFFFFFFF, np.uint32)
+                col[:len(order)] = rk[order, w]
+                carry_np["lvlk"][w][d] = col
+        carry_np["n_lvl"] = nl
+        carry = jax.tree_util.tree_map(jnp.asarray, carry_np)
+
+        n_states = 0
+        n_vis = np.zeros((D,), np.int64)
+        depth = 0
+
+        def run_finalize(carry):
+            need = int(n_vis.max()) + self.LB
+            if need > self.VB:
+                while self.VB < need:
+                    self.VB *= 4
+                carry = dict(carry)
+                carry["vis"] = tuple(
+                    jnp.concatenate(
+                        [carry["vis"][w],
+                         jnp.full((D, self.VB -
+                                   carry["vis"][w].shape[1]), U32MAX)],
+                        axis=1)
+                    for w in range(W))
+            carry, out = self._fin_jit(carry)
+            return carry, out, np.asarray(out["scal"])     # [D, 9]
+
+        def harvest(carry, out, scal):
+            nonlocal n_states
+            nl = scal[:, 0]
+            n_lvl = int(nl.sum())
+            res.distinct_states += n_lvl
+            res.overflow_faults += int(scal[:, 2].sum())
+            res.generated_states += int(scal[:, 6].sum())
+            prefix = np.cumsum(nl) - nl
+            if self.store_states:
+                pars = np.asarray(carry["lpar"])
+                lns = np.asarray(carry["llane"])
+                self._parents.append(np.concatenate(
+                    [pars[d, :nl[d]] for d in range(D)]))
+                self._lanes.append(np.concatenate(
+                    [lns[d, :nl[d]] for d in range(D)]))
+                rows = {k: np.asarray(v)
+                        for k, v in carry["front"].items()}
+                self._states.append(
+                    {k: np.concatenate([rows[k][d, :nl[d]]
+                                        for d in range(D)])
+                     for k in rows})
+            if scal[:, 1].sum():
+                inv_ok = np.asarray(out["inv_ok"])
+                rows = {k: np.asarray(v)
+                        for k, v in carry["front"].items()}
+                for d in range(D):
+                    for j, nm in enumerate(self.inv_names):
+                        for s in np.nonzero(~inv_ok[d, :nl[d], j])[0]:
+                            vsv, vh = decode(lay, _take(
+                                {k: rows[k][d] for k in rows}, s))
+                            res.violations.append(Violation(
+                                nm, n_states + int(prefix[d]) + int(s),
+                                state=vsv, hist=vh))
+            n_states += n_lvl
+            for d in range(D):
+                n_vis[d] += nl[d]
+            # global state ids are device int32; fail loud, not wrap
+            if n_states >= 2 ** 31 - 1:
+                raise RuntimeError(
+                    "state-id space exhausted (2^31 ids): run exceeds "
+                    "the engine's int32 global-id width")
+            return int(scal[:, 3].max())
+
+        carry, out, scal = run_finalize(carry)
+        n_front = harvest(carry, out, scal)
+        if stop_on_violation and res.violations:
+            res.seconds = time.time() - t0
+            return res
+
+        while n_front and depth < max_depth and \
+                res.distinct_states < max_states:
+            depth += 1
+            while True:
+                n_chunks = (n_front + self.BL - 1) // self.BL
+                for _ in range(n_chunks):
+                    carry = self._step_jit(carry)
+                carry, out, scal = run_finalize(carry)
+                ovf = bool(scal[:, 4].any())
+                fovf = bool(scal[:, 5].any())
+                sovf = bool(scal[:, 8].any())
+                if not (ovf or fovf or sovf):
+                    break
+                if fovf:
+                    self.FC *= 4
+                if sovf or fovf:
+                    self.SC = max(4 * self.SC, 4 * self.FC // self.D)
+                if ovf or self.LB < max(4 * self.FC,
+                                        2 * self.D * self.SC):
+                    self.LB = self._round_lb(
+                        max((4 * self.LB) if ovf else self.LB,
+                            4 * self.FC, 2 * self.D * self.SC))
+                self._set_tb()
+                if verbose:
+                    print(f"level {depth}: overflow "
+                          f"(ovf={ovf} fovf={fovf} sovf={sovf}), "
+                          f"LB={self.LB} FC={self.FC} SC={self.SC}")
+                carry = self._grow_sharded(carry)
+            n_front = harvest(carry, out, scal)
+            if int(scal[:, 0].sum()) == 0 and int(scal[:, 6].sum()) == 0:
+                depth -= 1
+            else:
+                res.level_sizes.append(int(scal[:, 7].sum()))
+            if stop_on_violation and res.violations:
+                break
+            if verbose:
+                print(f"depth {depth}: +{int(scal[:, 0].sum())} states "
+                      f"(total {res.distinct_states}), "
+                      f"frontier(max/dev) {n_front}")
+        res.depth = depth
+        res.seconds = time.time() - t0
+        return res
+
+    def _grow_sharded(self, carry):
+        """Re-home the carry in bigger per-device buffers (frontier and
+        visited survive; the level buffer resets — the level replays)."""
+        D, W = self.D, self.W
+        old = carry
+        new = self._fresh_sharded_carry()
+        ovb = old["vis"][0].shape[1]           # .shape: no transfer
+        new["vis"] = tuple(
+            jnp.concatenate(
+                [old["vis"][w],
+                 jnp.full((D, self.VB - ovb), U32MAX)], axis=1)
+            if self.VB > ovb else old["vis"][w]
+            for w in range(W))
+        olb = old["fmask"].shape[1]
+        pad = self.LB - olb
+        new["front"] = {k: jnp.concatenate(
+            [old["front"][k],
+             jnp.zeros((D, pad) + v.shape[2:], v.dtype)], axis=1)
+            for k, v in old["front"].items()}
+        new["fmask"] = jnp.concatenate(
+            [old["fmask"], jnp.zeros((D, pad), bool)], axis=1)
+        new["lvlk"] = tuple(jnp.full((D, self.LB), U32MAX)
+                            for _ in range(W))
+        new["n_front"] = old["n_front"]
+        new["g_off"] = old["g_off"]
+        new["pg_off"] = old["pg_off"]
+        return new
+
+    # ------------------------------------------------------------------
+    # collective demo kept for the driver dry run
+    # ------------------------------------------------------------------
 
     def device_fingerprint_gather(self, svb: Dict[str, jnp.ndarray]):
-        """The explicit-collective path: shard_map the expansion and
-        all_gather the fingerprint blocks over ICI, returning the
-        globally-assembled [B, A, streams] fingerprints.  Used by the
-        multi-chip dry run to prove the collective compiles + executes."""
-        from jax.experimental.shard_map import shard_map
-
+        """shard_map the expansion and all_gather the fingerprint
+        blocks over ICI, returning globally-assembled [B, A, streams]
+        fingerprints — proves the collective path compiles+executes."""
         def local(svb_local):
             _ok, _cand, fp = self._phase1_impl(svb_local)
-            return jax.lax.all_gather(fp, "frontier", tiled=True)
+            return jax.lax.all_gather(fp, "d", tiled=True)
 
-        fn = shard_map(
-            local, mesh=self.mesh,
-            in_specs=({k: P("frontier") for k in self._state_keys()},),
-            out_specs=P(None),
-            check_rep=False)
+        from ..ops.codec import ALL_KEYS
+        fn = _shard_map(
+            local, self.mesh,
+            ({k: P("d") for k in ALL_KEYS},), P(None))
         return fn(svb)
