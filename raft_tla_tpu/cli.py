@@ -140,6 +140,14 @@ def _engine_seed_arrays(cfg, engine_seeds):
 def cmd_check(args):
     cfg = load_model(args.cfg, bounds=None)
     cfg = _apply_overrides(cfg, args)
+    if args.engine == "oracle" and (args.resume or args.checkpoint):
+        print("--checkpoint/--resume are tpu-engine features",
+              file=sys.stderr)
+        return 2
+    if args.resume and args.seed_trace:
+        print("--resume and --seed-trace are mutually exclusive",
+              file=sys.stderr)
+        return 2
     oracle_seeds = engine_seeds = None
     if args.seed_trace:
         oracle_seeds, raw = _load_seeds(args.seed_trace)
@@ -179,7 +187,10 @@ def cmd_check(args):
                      store_states=not args.no_store)
         r = eng.check(max_depth=args.max_depth, max_states=args.max_states,
                       stop_on_violation=not args.keep_going,
-                      verbose=args.verbose, seed_states=engine_seeds)
+                      verbose=args.verbose, seed_states=engine_seeds,
+                      checkpoint_path=args.checkpoint,
+                      checkpoint_every=args.checkpoint_every,
+                      resume_from=args.resume)
         secs = r.seconds
         viol = []
         for v in r.violations[:args.max_violations]:
@@ -313,6 +324,19 @@ def main(argv=None):
     pc.add_argument("--no-store", action="store_true",
                     help="do not retain states (no traces; less memory)")
     pc.add_argument("--max-violations", type=int, default=5)
+    pc.add_argument("--checkpoint", default=None, metavar="FILE",
+                    help="write a resumable checkpoint every "
+                         "--checkpoint-every levels (tpu engine; TLC's "
+                         "states/ dir counterpart)")
+    pc.add_argument("--checkpoint-every", type=int, default=5,
+                    metavar="N",
+                    help="levels between checkpoints (each checkpoint "
+                         "is a full snapshot incl. the visited set and "
+                         "any trace archives — frequent checkpoints on "
+                         "deep store_states runs are I/O-heavy)")
+    pc.add_argument("--resume", default=None, metavar="FILE",
+                    help="resume a checkpointed run (final counts are "
+                         "identical to an uninterrupted run)")
     pc.add_argument("--seed-trace", default=None, metavar="FILE",
                     help="punctuated search: explore only extensions of "
                          "the seed state(s) in FILE (emitted by `trace "

@@ -526,54 +526,71 @@ class Engine:
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
               seed_states: Optional[List] = None,
+              checkpoint_path: Optional[str] = None,
+              checkpoint_every: int = 1,
+              resume_from: Optional[str] = None,
               verbose: bool = False) -> CheckResult:
         """seed_states entries are (State, Hist) pairs or raw SoA dicts
         (the latter preserve feature lanes exactly — engine-emitted
-        seeds; punctuated search, SURVEY §2.9)."""
+        seeds; punctuated search, SURVEY §2.9).
+
+        checkpoint_path — write a checkpoint there every
+        ``checkpoint_every`` levels; resume_from — continue a prior
+        checkpointed run (final counts are identical to an
+        uninterrupted run; levels are never half-resumed)."""
         t0 = time.time()
         lay = self.lay
-        init_list = (seed_states if seed_states is not None
-                     else [init_state(self.cfg)])
-        init_arrs = _cat([
-            {k: np.asarray(v)[None] for k, v in s.items()}
-            if isinstance(s, dict) else
-            {k: v[None] for k, v in encode(lay, *s).items()}
-            for s in init_list])
-        rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
-        root_fp = np.asarray(self._rootfp_jit(rootsb))
-        root_keys = fp_key(root_fp)
-        _uniq, first_idx = np.unique(root_keys, return_index=True)
-        first_idx.sort()
-        roots = _take(init_arrs, first_idx)
-        n_roots = len(first_idx)
-
-        res = CheckResult(distinct_states=0, generated_states=n_roots,
-                          depth=0)
         self._states: List[Dict[str, np.ndarray]] = []
         self._parents: List[np.ndarray] = []
         self._lanes: List[np.ndarray] = []
 
-        while self.LCAP - self.FCAP < 2 * n_roots:
-            self.LCAP *= 2
-        carry = self._fresh_carry(self.LCAP, self.VCAP)
-        # roots enter through the same admit path as every level: place
-        # them in the level buffer and finalize.
-        pad = self.LCAP - n_roots
-        carry["lvl"] = {k: jnp.asarray(np.concatenate(
-            [roots[k], np.zeros((pad,) + roots[k].shape[1:],
-                                roots[k].dtype)]))
-            for k in roots}
-        rk = np.asarray(root_fp[first_idx], dtype=np.uint32)
-        # lexicographic row sort (np.lexsort: LAST key is primary)
-        order = np.lexsort(tuple(rk[:, w]
-                                 for w in range(self.W - 1, -1, -1)))
-        carry["lvlk"] = tuple(jnp.asarray(np.concatenate(
-            [rk[order, w], np.full(pad, 0xFFFFFFFF, np.uint32)]))
-            for w in range(self.W))
-        carry["n_lvl"] = jnp.int32(n_roots)
-        n_states = 0
-        n_vis = 0
-        depth = 0
+        if resume_from is not None:
+            carry, res, meta = self._load_checkpoint(resume_from)
+            n_states = meta["n_states"]
+            n_vis = meta["n_vis"]
+            depth = meta["depth"]
+            n_front = meta["n_front"]
+            resumed = True
+        else:
+            init_list = (seed_states if seed_states is not None
+                         else [init_state(self.cfg)])
+            init_arrs = _cat([
+                {k: np.asarray(v)[None] for k, v in s.items()}
+                if isinstance(s, dict) else
+                {k: v[None] for k, v in encode(lay, *s).items()}
+                for s in init_list])
+            rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
+            root_fp = np.asarray(self._rootfp_jit(rootsb))
+            root_keys = fp_key(root_fp)
+            _uniq, first_idx = np.unique(root_keys, return_index=True)
+            first_idx.sort()
+            roots = _take(init_arrs, first_idx)
+            n_roots = len(first_idx)
+
+            res = CheckResult(distinct_states=0,
+                              generated_states=n_roots, depth=0)
+            while self.LCAP - self.FCAP < 2 * n_roots:
+                self.LCAP *= 2
+            carry = self._fresh_carry(self.LCAP, self.VCAP)
+            # roots enter through the same admit path as every level:
+            # place them in the level buffer and finalize.
+            pad = self.LCAP - n_roots
+            carry["lvl"] = {k: jnp.asarray(np.concatenate(
+                [roots[k], np.zeros((pad,) + roots[k].shape[1:],
+                                    roots[k].dtype)]))
+                for k in roots}
+            rk = np.asarray(root_fp[first_idx], dtype=np.uint32)
+            # lexicographic row sort (np.lexsort: LAST key is primary)
+            order = np.lexsort(tuple(rk[:, w]
+                                     for w in range(self.W - 1, -1, -1)))
+            carry["lvlk"] = tuple(jnp.asarray(np.concatenate(
+                [rk[order, w], np.full(pad, 0xFFFFFFFF, np.uint32)]))
+                for w in range(self.W))
+            carry["n_lvl"] = jnp.int32(n_roots)
+            n_states = 0
+            n_vis = 0
+            depth = 0
+            resumed = False
         t_dev = 0.0
 
         def run_finalize(carry):
@@ -625,8 +642,9 @@ class Engine:
                     "the engine's int32 global-id width")
             return n_front
 
-        carry, out, scal = run_finalize(carry)
-        n_front = harvest(carry, out, scal)
+        if not resumed:
+            carry, out, scal = run_finalize(carry)
+            n_front = harvest(carry, out, scal)
         if stop_on_violation and res.violations:
             res.seconds = time.time() - t0
             return res
@@ -671,6 +689,10 @@ class Engine:
                 # post-constraint frontier size, the oracle's metric
                 res.level_sizes.append(scal[7])
             t_dev += time.time() - t1
+            if checkpoint_path is not None and \
+                    depth % max(1, checkpoint_every) == 0:
+                self._save_checkpoint(checkpoint_path, carry, res,
+                                      depth, n_states, n_vis, n_front)
             if stop_on_violation and res.violations:
                 break
             if verbose:
@@ -691,6 +713,97 @@ class Engine:
                              jnp.full((vcap - ovcap,), U32MAX)])
             for w in range(self.W))
         return carry
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (TLC checkpoints to states/ —
+    # /root/reference/.gitignore:4; SURVEY §5).  A checkpoint is the
+    # full BFS wavefront: {carry pytree, level counters, result-so-far,
+    # and (when store_states) the parent/lane/state archives needed for
+    # trace reconstruction}.  Written at level boundaries, so a resumed
+    # run replays nothing and lands on bit-identical counts.
+    # ------------------------------------------------------------------
+
+    def _save_checkpoint(self, path, carry, res, depth, n_states,
+                         n_vis, n_front):
+        import json
+        data = {}
+        leaves = jax.tree_util.tree_flatten_with_path(carry)[0]
+        for kp, leaf in leaves:
+            name = "carry|" + "|".join(
+                str(getattr(p, "key", getattr(p, "idx", p)))
+                for p in kp)
+            data[name] = np.asarray(leaf)
+        if self.store_states:
+            for i, arr in enumerate(self._parents):
+                data[f"parents|{i}"] = arr
+            for i, arr in enumerate(self._lanes):
+                data[f"lanes|{i}"] = arr
+            for i, blk in enumerate(self._states):
+                for k, v in blk.items():
+                    data[f"states|{i}|{k}"] = v
+        data["viol_names"] = np.array(
+            [v.invariant for v in res.violations])
+        data["viol_ids"] = np.array(
+            [v.state_id for v in res.violations], dtype=np.int64)
+        data["meta"] = np.array(json.dumps(dict(
+            depth=depth, n_states=n_states, n_vis=n_vis,
+            n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
+            FCAP=self.FCAP,
+            distinct=res.distinct_states,
+            generated=res.generated_states,
+            faults=res.overflow_faults,
+            level_sizes=res.level_sizes,
+            n_levels=len(self._parents),
+            store_states=self.store_states,
+            cfg=repr(self.cfg))))
+        import os
+        tmp = path + ".tmp.npz"       # .npz suffix: savez won't append
+        np.savez(tmp, **data)
+        os.replace(tmp, path)
+
+    def _load_checkpoint(self, path):
+        import json
+        z = np.load(path, allow_pickle=False)
+        meta = json.loads(str(z["meta"]))
+        if meta["cfg"] != repr(self.cfg):
+            raise ValueError(
+                "checkpoint was written for a different model config:\n"
+                f"  checkpoint: {meta['cfg']}\n"
+                f"  engine:     {self.cfg!r}")
+        self.LCAP, self.VCAP, self.FCAP = (meta["LCAP"], meta["VCAP"],
+                                           meta["FCAP"])
+        template = self._fresh_carry(self.LCAP, self.VCAP, self.FCAP)
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+        vals = []
+        for kp, _ in leaves:
+            name = "carry|" + "|".join(
+                str(getattr(p, "key", getattr(p, "idx", p)))
+                for p in kp)
+            vals.append(jnp.asarray(z[name]))
+        carry = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(template), vals)
+        if self.store_states and not meta["store_states"]:
+            raise ValueError(
+                "checkpoint was written with store_states=False; "
+                "resume with store_states=False (CLI: --no-store) — "
+                "trace archives cannot be reconstructed")
+        if self.store_states and meta["store_states"]:
+            self._parents = [z[f"parents|{i}"]
+                             for i in range(meta["n_levels"])]
+            self._lanes = [z[f"lanes|{i}"]
+                           for i in range(meta["n_levels"])]
+            keys = list(template["lvl"].keys())
+            self._states = [
+                {k: z[f"states|{i}|{k}"] for k in keys}
+                for i in range(meta["n_levels"])]
+        res = CheckResult(
+            distinct_states=meta["distinct"],
+            generated_states=meta["generated"], depth=meta["depth"],
+            level_sizes=list(meta["level_sizes"]),
+            overflow_faults=meta["faults"])
+        for nm, sid in zip(z["viol_names"], z["viol_ids"]):
+            res.violations.append(Violation(str(nm), int(sid)))
+        return carry, res, meta
 
     # ------------------------------------------------------------------
 

@@ -359,7 +359,14 @@ class ShardedEngine(Engine):
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
               seed_states: Optional[List] = None,
+              checkpoint_path: Optional[str] = None,
+              checkpoint_every: int = 1,
+              resume_from: Optional[str] = None,
               verbose: bool = False) -> CheckResult:
+        if checkpoint_path or resume_from:
+            raise NotImplementedError(
+                "checkpoint/resume is single-device only for now "
+                "(the sharded carry layout needs its own serializer)")
         t0 = time.time()
         lay = self.lay
         D, W, LB = self.D, self.W, self.LB
