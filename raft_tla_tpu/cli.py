@@ -185,12 +185,20 @@ def cmd_check(args):
         from .engine.bfs import Engine
         eng = Engine(cfg, chunk=args.chunk,
                      store_states=not args.no_store)
-        r = eng.check(max_depth=args.max_depth, max_states=args.max_states,
-                      stop_on_violation=not args.keep_going,
-                      verbose=args.verbose, seed_states=engine_seeds,
-                      checkpoint_path=args.checkpoint,
-                      checkpoint_every=args.checkpoint_every,
-                      resume_from=args.resume)
+        try:
+            r = eng.check(max_depth=args.max_depth,
+                          max_states=args.max_states,
+                          stop_on_violation=not args.keep_going,
+                          verbose=args.verbose, seed_states=engine_seeds,
+                          checkpoint_path=args.checkpoint,
+                          checkpoint_every=args.checkpoint_every,
+                          resume_from=args.resume)
+        except (ValueError, FileNotFoundError) as e:
+            if not args.resume:
+                raise
+            print(f"cannot resume from {args.resume}: {e}",
+                  file=sys.stderr)
+            return 2
         secs = r.seconds
         viol = []
         for v in r.violations[:args.max_violations]:

@@ -123,6 +123,13 @@ def _ceil_log2(n: int) -> int:
     return max(1, int(np.ceil(np.log2(max(n, 2)))))
 
 
+def _leaf_name(key_path) -> str:
+    """Stable archive name for a carry pytree leaf (shared by
+    checkpoint save and load — must stay in lockstep)."""
+    return "carry|" + "|".join(
+        str(getattr(p, "key", getattr(p, "idx", p))) for p in key_path)
+
+
 class Engine:
     """One compiled checker instance per (ModelConfig, chunk size).
 
@@ -729,10 +736,7 @@ class Engine:
         data = {}
         leaves = jax.tree_util.tree_flatten_with_path(carry)[0]
         for kp, leaf in leaves:
-            name = "carry|" + "|".join(
-                str(getattr(p, "key", getattr(p, "idx", p)))
-                for p in kp)
-            data[name] = np.asarray(leaf)
+            data[_leaf_name(kp)] = np.asarray(leaf)
         if self.store_states:
             for i, arr in enumerate(self._parents):
                 data[f"parents|{i}"] = arr
@@ -748,7 +752,7 @@ class Engine:
         data["meta"] = np.array(json.dumps(dict(
             depth=depth, n_states=n_states, n_vis=n_vis,
             n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
-            FCAP=self.FCAP,
+            FCAP=self.FCAP, chunk=self.chunk,
             distinct=res.distinct_states,
             generated=res.generated_states,
             faults=res.overflow_faults,
@@ -770,16 +774,20 @@ class Engine:
                 "checkpoint was written for a different model config:\n"
                 f"  checkpoint: {meta['cfg']}\n"
                 f"  engine:     {self.cfg!r}")
+        if meta["chunk"] != self.chunk:
+            raise ValueError(
+                f"checkpoint was written with chunk={meta['chunk']}; "
+                f"resume with the same chunk (engine has {self.chunk} — "
+                "capacities are rounded to the chunk size)")
         self.LCAP, self.VCAP, self.FCAP = (meta["LCAP"], meta["VCAP"],
                                            meta["FCAP"])
-        template = self._fresh_carry(self.LCAP, self.VCAP, self.FCAP)
+        # eval_shape: the template is only read for structure/key paths,
+        # never materialized (a real _fresh_carry would transiently
+        # double device memory at resume)
+        template = jax.eval_shape(
+            lambda: self._fresh_carry(self.LCAP, self.VCAP, self.FCAP))
         leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
-        vals = []
-        for kp, _ in leaves:
-            name = "carry|" + "|".join(
-                str(getattr(p, "key", getattr(p, "idx", p)))
-                for p in kp)
-            vals.append(jnp.asarray(z[name]))
+        vals = [jnp.asarray(z[_leaf_name(kp)]) for kp, _ in leaves]
         carry = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(template), vals)
         if self.store_states and not meta["store_states"]:

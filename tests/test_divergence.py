@@ -1,0 +1,136 @@
+"""The apalache-variant divergence (SURVEY §2.7, §7.3 exit criterion).
+
+apalache_no_membership knowingly ships Ricketts' original —
+documented-FALSE — forms of VotesGrantedInv and LeaderCompleteness as
+its live invariants (apalache_no_membership/raft.tla:715-723, 746-750;
+the tlc variant documents the falsity at tlc_membership/raft.tla:
+1028-1035, 1072-1075).  A faithful checker must FIND the
+LeaderCompleteness violation: it fires when a commit happens under
+concurrent leaders, which needs >= 3 servers (the shipped cfg binds
+Server={1,2}, where concurrent leaders are unreachable — so the spec
+"checked clean" for its authors).
+
+The hunt uses the reference's own signature technique: punctuated
+search.  The 20-record ConcurrentLeaders witness (the hard-coded
+prefix inside CommitWhenConcurrentLeaders_unique,
+tlc_membership/raft.tla:1198-1204) replays under the apalache-variant
+semantics at S=3 and seeds the search; both the oracle and the TPU
+engine then find the commit-under-two-leaders violation of the false
+LeaderCompleteness at the same depth, and the corrected (verdi-raft)
+form of the tlc variant holds on the very same search — proving the
+divergence is the invariant FORM, not the engine.
+"""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+
+from golden import CONCURRENT_LEADERS_LABELS, CWCL_EXTENSION_LABELS
+
+from raft_tla_tpu.cfg.parser import load_model
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.engine.bfs import Engine
+from raft_tla_tpu.models import predicates
+from raft_tla_tpu.models.explore import explore
+from raft_tla_tpu.models.raft import init_state, successors
+
+
+def _ap_cfg():
+    cfg = load_model("/root/reference/apalache_no_membership/raft.cfg",
+                     bounds=Bounds.make(max_log_length=2, max_timeouts=3,
+                                        max_client_requests=2))
+    # concurrent leaders need 3 servers; the shipped Server={1,2}
+    # binding cannot reach the violation
+    return cfg.with_(n_servers=3, init_servers=(0, 1, 2))
+
+
+def _seed(cfg, labels=CONCURRENT_LEADERS_LABELS):
+    sv, h = init_state(cfg)
+    for lbl in labels:
+        matches = [(s2, h2) for l, s2, h2 in successors(sv, h, cfg)
+                   if l == lbl]
+        assert len(matches) == 1, lbl
+        sv, h = matches[0]
+    return sv, h
+
+
+def test_apalache_false_leader_completeness_found():
+    """Oracle and TPU engine, seeded with the ConcurrentLeaders
+    witness, find the LeaderCompleteness_false violation at the same
+    depth; the live apalache name resolves to the false form."""
+    cfg = _ap_cfg().with_(invariants=("LeaderCompleteness",))
+    assert cfg.apalache_variant
+    fn = predicates.resolve_invariant("LeaderCompleteness", cfg)
+    assert fn is predicates.INVARIANTS["LeaderCompleteness_false"]
+
+    seed = _seed(cfg)
+    want = explore(cfg, seed_states=[seed], stop_on_violation=True,
+                   trace_violations=True, max_states=200_000)
+    assert want.violations, "oracle did not find the violation"
+    assert want.violations[0].invariant == "LeaderCompleteness"
+
+    eng = Engine(cfg, chunk=256, store_states=True)
+    got = eng.check(seed_states=[seed], stop_on_violation=True,
+                    max_states=200_000)
+    assert got.violations, "engine did not find the violation"
+    assert got.violations[0].invariant == "LeaderCompleteness"
+    assert got.depth == want.depth, (got.depth, want.depth)
+    # the engine reconstructs a witness extension ending in the commit
+    chain = eng.trace(got.violations[0].state_id)
+    labels = [lbl for lbl, _ in chain]
+    assert any(lbl.startswith("AdvanceCommitIndex") for lbl in labels)
+
+
+def test_apalache_false_votes_granted_inv_found():
+    """VotesGrantedInv_false fires one step past the 28-record
+    CommitWhenConcurrentLeaders witness: UpdateTerm pulls the old
+    term-2 leader s0 (whose STALE votesGranted={s0,s1} survives, the
+    exact variable-meaning confusion the reference documents at
+    tlc_membership/raft.tla:1028-1035) into s1's term while s1 holds
+    committed entries that conflict with s0's log.  Both engines find
+    it at depth 1 from the seed."""
+    cfg = _ap_cfg().with_(invariants=("VotesGrantedInv",))
+    fn = predicates.resolve_invariant("VotesGrantedInv", cfg)
+    assert fn is predicates.INVARIANTS["VotesGrantedInv_false"]
+
+    seed = _seed(cfg, CONCURRENT_LEADERS_LABELS + CWCL_EXTENSION_LABELS)
+    want = explore(cfg, seed_states=[seed], stop_on_violation=True,
+                   trace_violations=True, max_states=50_000)
+    assert want.violations
+    assert want.violations[0].invariant == "VotesGrantedInv"
+    assert want.depth == 1          # UpdateTerm(0) away from the seed
+
+    eng = Engine(cfg, chunk=256, store_states=True)
+    got = eng.check(seed_states=[seed], stop_on_violation=True,
+                    max_states=50_000)
+    assert got.violations
+    assert got.violations[0].invariant == "VotesGrantedInv"
+    assert got.depth == want.depth
+
+
+def test_corrected_votes_granted_inv_holds_on_same_search():
+    """Contrast: the tlc variant's corrected VotesGrantedInv
+    (votedFor-based, tlc_membership/raft.tla:1048-1052) holds on the
+    same seeded search."""
+    cfg = _ap_cfg().with_(invariants=("VotesGrantedInv",),
+                          apalache_variant=False)
+    fn = predicates.resolve_invariant("VotesGrantedInv", cfg)
+    assert fn is predicates.INVARIANTS["VotesGrantedInv"]
+    seed = _seed(cfg, CONCURRENT_LEADERS_LABELS + CWCL_EXTENSION_LABELS)
+    r = explore(cfg, seed_states=[seed], max_states=5_000)
+    assert not r.violations
+
+
+def test_corrected_leader_completeness_holds_on_same_search():
+    """Contrast: the tlc variant's corrected LeaderCompleteness
+    (verdi-raft form, tlc_membership/raft.tla:1089-1099) holds on the
+    exact same seeded search that violates the false form."""
+    cfg = _ap_cfg().with_(invariants=("LeaderCompleteness",),
+                          apalache_variant=False)
+    fn = predicates.resolve_invariant("LeaderCompleteness", cfg)
+    assert fn is predicates.INVARIANTS["LeaderCompleteness"]
+    seed = _seed(cfg)
+    r = explore(cfg, seed_states=[seed], max_states=5_000)
+    assert not r.violations
