@@ -29,7 +29,10 @@ import time
 # current 620B/state exceeds single-chip HBM alongside the frontier.
 BUDGET = 2_400_000
 LCAP = 1 << 21
-VCAP = 1 << 23
+# sized so the visited table never crosses the load bound mid-run (a
+# growth would rehash + retrace the fused kernels: ~100s of remote
+# compile through the tunnel)
+VCAP = 1 << 24
 
 
 def main():

@@ -182,7 +182,7 @@ def cmd_check(args):
         distinct, depth, gen = r.distinct_states, r.depth, \
             r.generated_states
     else:
-        from .engine.bfs import Engine
+        from .engine.bfs import CheckpointError, Engine
         eng = Engine(cfg, chunk=args.chunk,
                      store_states=not args.no_store)
         try:
@@ -193,7 +193,9 @@ def cmd_check(args):
                           checkpoint_path=args.checkpoint,
                           checkpoint_every=args.checkpoint_every,
                           resume_from=args.resume)
-        except (ValueError, FileNotFoundError) as e:
+        except (CheckpointError, FileNotFoundError) as e:
+            # only checkpoint load/format problems — a mid-run error
+            # after a successful resume propagates with its real trace
             if not args.resume:
                 raise
             print(f"cannot resume from {args.resume}: {e}",

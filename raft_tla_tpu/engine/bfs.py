@@ -2,32 +2,42 @@
 
 Replaces the reference's external checker (SURVEY §2.13: TLC's BFS +
 fingerprint set + invariant eval) with a **device-resident** pipeline:
-the frontier, the candidate expansion, the fingerprint set (a sorted
-multi-word key array in HBM), the per-level dedup, the invariant /
+the frontier, the candidate expansion, the fingerprint set (an
+open-addressing hash table in HBM), the dedup, the invariant /
 constraint evaluation and the next-frontier compaction all live on
 device.  Per frontier chunk the host issues ONE fused jit call
-(expand + fingerprint + action constraints + intra-chunk first-seen
-dedup + membership probe + scatter into the level buffer) with a
-donated carry, so chunk steps pipeline asynchronously; the only
-per-level synchronization is reading back a handful of scalars
-(new-state count, violation count, next-frontier size).
+(expand + fingerprint + action constraints + claim-insert dedup +
+invariant/constraint eval on the fresh rows + scatter into the level
+buffer) with a donated carry, so chunk steps pipeline asynchronously;
+the only per-level synchronization is reading back a handful of
+scalars (new-state count, violation count, next-frontier size).
 
-State identity follows TLC's semantics: the visited set stores the
+State identity follows TLC's semantics: the visited table stores the
 symmetry-canonical VIEW fingerprints (engine/fingerprint) as
-``n_streams`` u32 words compared lexicographically; first-seen survivor
-order matches the Python oracle (chunk-sequential, candidate-index
-order within a chunk — SURVEY §7.4 pt 5).  CONSTRAINT semantics are
-prune-not-reject: violating states are counted and checked but not
+``n_streams`` u32 words; first-seen survivor order matches the Python
+oracle (chunk-sequential, candidate-index order within a chunk —
+SURVEY §7.4 pt 5) via rank-tie-broken claims.  CONSTRAINT semantics
+are prune-not-reject: violating states are counted and checked but not
 expanded (§2.8).  Parent pointers (state-id, lane-id) stream to the
 host per level for trace reconstruction (SURVEY §7.2 L5).
 
-Capacity model: the visited set (VCAP keys) and the per-level buffer
-(LCAP states) are fixed-shape device arrays padded with an all-ones
-sentinel key; when a level or the visited set outgrows its capacity the
-engine doubles the cap, recompiles (one extra jit cache entry per
-doubling) and — for the level buffer — replays the level from the
-intact frontier (the visited set is only merged at level end, so the
-replay is exact).
+Dedup design (the hot path — profiled on the tunneled TPU): a
+membership query against the table costs ~1-3 dependent gathers
+(quadratic probing at load factor <= _LOAD_MAX), versus the ~22-24
+gather rounds per query of the sorted-array binary search this
+replaced; inserts happen inside the same probe walk via a scatter-min
+claim round, so there is no per-chunk sort and no per-level key merge
+at all.  Each level journals its inserted slots; a level abandoned for
+buffer overflow rolls the table back by clearing exactly those slots
+(safe: a cleared cohort postdates every surviving key, so it cannot
+sit on a surviving key's probe path — see _probe_insert).
+
+Capacity model: the table (VCAP slots, power of two) and the per-level
+buffer (LCAP states) are fixed-shape device arrays; when a level
+outgrows LCAP (or the table's load bound trips) the engine grows the
+cap, recompiles (one extra jit cache entry per growth), rolls back and
+replays the level from the intact frontier.  The table grows by
+rehashing into a larger table on device.
 """
 
 from __future__ import annotations
@@ -48,9 +58,29 @@ from ..ops.kernels import RaftKernels
 from ..ops.layout import Layout
 from ..ops.vpredicates import Predicates
 from .expand import Expander
-from .fingerprint import Fingerprinter, combine_u64
+from .fingerprint import Fingerprinter, combine_u64, fmix32
 
 U32MAX = jnp.uint32(0xFFFFFFFF)
+
+
+def _fmix32_int(x: int) -> int:
+    """Host twin of fingerprint.fmix32 (murmur3 finalizer) on ints."""
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+_HOME_SALT = 0x9E3779B9
+
+
+class CheckpointError(ValueError):
+    """Checkpoint missing, malformed, or written by an incompatible
+    engine version/config.  The CLI catches exactly this for its
+    'cannot resume' message; unrelated mid-run ValueErrors propagate."""
 
 _CACHE_ENABLED = False
 
@@ -161,11 +191,17 @@ class Engine:
         # capacities (LCAP always a multiple of chunk).  FCAP bounds the
         # fresh-per-chunk compaction buffer; LCAP reserves an FCAP-sized
         # append margin (usable level capacity is LCAP - FCAP).
+        # FCAP: measured enabled-lane density on the metric config is
+        # ~4 lanes/state on the widest levels but spikes past 8/state
+        # on mid-depth chunks; chunk*16 avoids the fovf growth path,
+        # whose mid-run recompile costs ~100s on the tunneled TPU
         self.FCAP = int(fcap) if fcap else min(
             self.chunk * self.A, max(self.chunk * 16, 1 << 13))
         self.LCAP = self._round_cap(
             max(lcap, 4 * self.chunk, 4 * self.FCAP))
-        self.VCAP = int(vcap)
+        # open-addressing table: power-of-two capacity (mask indexing)
+        self.VCAP = 1 << _ceil_log2(int(vcap))
+        self._rehash_cache = {}
         self._phase1 = jax.jit(self._phase1_impl)
         self._phase2 = jax.jit(self._phase2_impl)
         self._step_jit = jax.jit(self._chunk_step_impl, donate_argnums=0)
@@ -224,55 +260,173 @@ class Engine:
     # device-resident dedup primitives
     # ------------------------------------------------------------------
 
-    def _lower_bound(self, arrs: Tuple[jnp.ndarray, ...],
-                     qs: Tuple[jnp.ndarray, ...]) -> jnp.ndarray:
-        """First index where the lexicographic W-word key >= query.
-        arrs: W × u32[C] sorted ascending (sentinel-padded); qs: W × u32[N].
-        Branchless fixed-depth binary search (the HBM-resident analog of
-        TLC's fingerprint-set probe)."""
-        C = arrs[0].shape[0]
-        lo = jnp.zeros(qs[0].shape, jnp.int32)
-        hi = jnp.full(qs[0].shape, C, jnp.int32)
-        for _ in range(_ceil_log2(C) + 1):
-            mid = lo + ((hi - lo) >> 1)
-            midc = jnp.clip(mid, 0, C - 1)
-            less = jnp.zeros(qs[0].shape, bool)
-            eq = jnp.ones(qs[0].shape, bool)
-            for w in range(self.W):
-                kw = arrs[w][midc]
-                less = less | (eq & (kw < qs[w]))
-                eq = eq & (kw == qs[w])
-            lo = jnp.where(less, mid + 1, lo)
-            hi = jnp.where(less, hi, mid)
-        return lo
+    # ------------------------------------------------------------------
+    # device-resident open-addressing visited table.  Empty slot =
+    # all-ones key (an all-ones fingerprint aliases "empty" with
+    # probability 2^-64 — the same accepted-risk class as TLC's
+    # fingerprint collisions; fp128 shrinks it to 2^-128).
+    # ------------------------------------------------------------------
 
-    def _member(self, arrs, qs) -> jnp.ndarray:
-        C = arrs[0].shape[0]
-        pos = jnp.clip(self._lower_bound(arrs, qs), 0, C - 1)
-        eq = jnp.ones(qs[0].shape, bool)
+    _MAX_PROBE_ROUNDS = 4096
+    _LOAD_MAX = 0.40
+
+    def _home(self, keys, vcap: int):
+        h = jnp.full(keys[0].shape, _HOME_SALT, jnp.uint32)
         for w in range(self.W):
-            eq = eq & (arrs[w][pos] == qs[w])
-        return eq
+            h = fmix32(h ^ keys[w])
+        return (h & jnp.uint32(vcap - 1)).astype(jnp.int32)
 
-    def _sorted_insert(self, arrs, ins, cap):
-        """Merge `ins` (W × u32[M], sentinel for dead lanes) into the
-        sorted sentinel-padded `arrs` (W × u32[cap]) via concat + sort;
-        real keys must fit in cap (checked by the caller's overflow
-        logic)."""
-        cat = tuple(jnp.concatenate([arrs[w], ins[w]])
-                    for w in range(self.W))
-        merged = lax.sort(cat, num_keys=self.W)
-        return tuple(merged[w][:cap] for w in range(self.W))
+    def _probe_insert(self, table, claims, keys, live, ranks):
+        """Parallel claim-insert of `keys` (W × u32[M]; lanes with
+        live=False are ignored) into the open-addressing `table`
+        (W × u32[VCAP]; `claims` u32[VCAP] all-U32MAX between calls).
+        Returns (table', claims', fresh, pos, hovf): fresh marks lanes
+        whose key was NOT already present and won its slot; pos is each
+        lane's final table slot.
+
+        Two-phase structure, shaped by TPU op costs (scatters are an
+        order of magnitude slower than gathers at these widths):
+
+        - WALK (inner while_loop, gathers only): every active lane
+          quadratic-probes (pos += ++t, full-cycle for power-of-2
+          capacity) until its current slot holds its key (duplicate)
+          or is empty (insertion candidate).
+        - RESOLVE (one scatter round per outer iteration): insertion
+          candidates claim their empty slot by scatter-min of the lane
+          rank (first-seen tie-break = the oracle's enumeration order,
+          since ranks ascend in candidate order); winners scatter
+          their keys into the table; claims are reset by a scatter of
+          the sentinel.  Losers — and duplicates of a key that just
+          won — stay active and re-walk from their current position in
+          the next outer iteration (equal keys walk identical probe
+          paths, so a duplicate always finds its winner).
+
+        The outer loop runs until every lane resolves — typically 2-3
+        iterations (≈12 scatter ops total), versus one 4-scatter round
+        per probe *step* in the naive formulation.  `hovf` reports a
+        blown round budget (table too full — caller grows, rehashes,
+        replays the level).
+
+        Rollback safety (used by _finalize_impl's abandon): every slot
+        on an inserted key's probe path was occupied by an *earlier*
+        insert at walk time, so clearing a whole trailing cohort of
+        inserts can never punch an empty hole into a surviving key's
+        path — lookups after rollback still terminate correctly.
+        """
+        VCAP = table[0].shape[0]
+        M = keys[0].shape[0]
+        pos0 = self._home(keys, VCAP)
+
+        def classify(table, pos):
+            cur = [table[w][pos] for w in range(self.W)]
+            iskey = jnp.ones((M,), bool)
+            isempty = jnp.ones((M,), bool)
+            for w in range(self.W):
+                iskey &= cur[w] == keys[w]
+                isempty &= cur[w] == U32MAX
+            return iskey, isempty
+
+        def outer_cond(st):
+            _t, _c, _p, _tt, active, _f, rounds = st
+            return active.any() & (rounds < self._MAX_PROBE_ROUNDS)
+
+        def outer_body(st):
+            table, claims, pos, t, active, fresh, rounds = st
+
+            # ---- walk: gathers only, no table writes ----
+            def walk_cond(ws):
+                _p, _t, moving, steps = ws
+                return moving.any() & (steps < self._MAX_PROBE_ROUNDS)
+
+            def walk_body(ws):
+                pos, t, moving, steps = ws
+                iskey, isempty = classify(table, pos)
+                adv = moving & ~(iskey | isempty)
+                t = jnp.where(adv, t + 1, t)
+                pos = jnp.where(adv, (pos + t) & (VCAP - 1), pos)
+                return pos, t, adv, steps + 1
+
+            pos, t, still_moving, _s = lax.while_loop(
+                walk_cond, walk_body, (pos, t, active, jnp.int32(0)))
+            iskey, isempty = classify(table, pos)
+            active = active & ~iskey               # duplicate: lane dies
+
+            # ---- resolve: one claim/insert/reset scatter round ----
+            claimers = active & isempty
+            cidx = jnp.where(claimers, pos, VCAP)
+            claims = claims.at[cidx].min(ranks, mode="drop")
+            won = claimers & (claims[pos] == ranks)
+            widx = jnp.where(won, pos, VCAP)
+            table = tuple(table[w].at[widx].set(keys[w], mode="drop")
+                          for w in range(self.W))
+            claims = claims.at[cidx].set(U32MAX, mode="drop")
+            fresh = fresh | won
+            active = active & ~won
+            return table, claims, pos, t, active, fresh, rounds + 1
+
+        state0 = (table, claims, pos0, jnp.zeros((M,), jnp.int32),
+                  live, jnp.zeros((M,), bool), jnp.int32(0))
+        table, claims, pos, _t, active, fresh, _r = lax.while_loop(
+            outer_cond, outer_body, state0)
+        return table, claims, fresh, pos, active.any()
+
+    def _host_probe_assign(self, keys: np.ndarray,
+                           vcap: Optional[int] = None) -> np.ndarray:
+        """Sequential host twin of _probe_insert against an EMPTY table
+        (root/punctuated-seed placement): same home hash and quadratic
+        advance, so the device continues the table consistently.  keys
+        are pre-deduped [N, W] u32."""
+        vcap = vcap if vcap is not None else self.VCAP
+        occupied = set()
+        out = np.zeros(len(keys), np.int32)
+        for i, kw in enumerate(keys):
+            h = _HOME_SALT
+            for w in range(self.W):
+                h = _fmix32_int(h ^ int(kw[w]))
+            pos, t = h & (vcap - 1), 0
+            while pos in occupied:
+                t += 1
+                pos = (pos + t) & (vcap - 1)
+            occupied.add(pos)
+            out[i] = pos
+        return out
+
+    def _rehash_tables(self, table, new_vcap: int):
+        """Grow the visited table: device-side rehash of every occupied
+        slot into a fresh table (and fresh claims array) of `new_vcap`
+        slots (one jit cache entry per (old, new) capacity pair)."""
+        old_vcap = table[0].shape[0]
+        fn = self._rehash_cache.get((old_vcap, new_vcap))
+        if fn is None:
+            def impl(table):
+                allones = jnp.ones((old_vcap,), bool)
+                for w in range(self.W):
+                    allones &= table[w] == U32MAX
+                new = tuple(jnp.full((new_vcap,), U32MAX)
+                            for _ in range(self.W))
+                ncl = jnp.full((new_vcap,), U32MAX)
+                ranks = jnp.arange(old_vcap, dtype=jnp.uint32)
+                new, ncl, _fresh, _pos, hv = self._probe_insert(
+                    new, ncl, table, ~allones, ranks)
+                return new, ncl, hv
+            fn = self._rehash_cache[(old_vcap, new_vcap)] = jax.jit(impl)
+        new, ncl, hv = fn(table)
+        if bool(np.asarray(hv)):
+            raise RuntimeError("rehash did not converge — table "
+                               "pathologically full; raise vcap")
+        return new, ncl
 
     # ------------------------------------------------------------------
     # fused per-chunk step (ONE device call per frontier chunk)
     # ------------------------------------------------------------------
 
     def _chunk_step_impl(self, carry):
-        """Expand frontier[base:base+chunk], fingerprint, dedup
-        (intra-chunk first-seen + visited + level membership) and
-        append the fresh states to the level buffer.  Everything stays
-        on device; `carry` is donated so buffers are reused.
+        """Expand frontier[base:base+chunk], fingerprint, dedup via the
+        visited hash table (claim-insert: intra-chunk first-seen,
+        cross-chunk and cross-level membership in ONE probe walk),
+        evaluate invariants/constraints on the fresh rows, and append
+        them to the level buffer.  Everything stays on device; `carry`
+        is donated so buffers are reused.
 
         Shaped for the TPU's strengths (profiled on hardware):
 
@@ -280,19 +434,23 @@ class Engine:
           fingerprinting, so the expensive min-over-perms hash runs on
           ~enabled candidates instead of the full B×A lane grid
           (typically ~10× fewer — the fingerprint dominated phase 1);
-        - the intra-chunk dedup sort is *unstable* with the compaction
-          slot as an extra sort key (first-of-run then still has the
-          smallest original lane index — the oracle's first-seen rule —
-          while avoiding XLA's slow stable-sort path);
+        - dedup is the open-addressing claim walk (_probe_insert):
+          ~1-3 dependent gathers per candidate instead of the 60+
+          binary-search gather rounds of the sorted-set design, and no
+          sorts anywhere in the step;
         - the level write is gather + contiguous dynamic_update_slice
           instead of a full-width scatter (TPU scatters are an order of
           magnitude slower than gathers at these shapes);
+        - invariants/constraints run here on the FCAP fresh rows, not
+          on the LCAP-wide level buffer at finalize — total predicate
+          work is O(distinct states), and finalize does no heavy work;
         - every phase boundary carries an optimization_barrier: without
           them XLA rematerializes the huge expansion graph into each
           consumer (measured 140ms/chunk vs ~20ms with barriers)."""
         B, A, W = self.chunk, self.A, self.W
         LCAP = carry["lpar"].shape[0]
         FCAP = carry["cidx"].shape[0]
+        VCAP = carry["vis"][0].shape[0]
         N = B * A
         base = carry["base"]        # device-resident chunk cursor: a
         # host-passed scalar would cost a blocking ~100ms host->device
@@ -332,37 +490,33 @@ class Engine:
         # fingerprint only the compacted candidates
         fp = lax.optimization_barrier(
             jax.vmap(self.fpr.fingerprint)(cand_c))      # [FCAP, W]
-        kws = tuple(jnp.where(elive, fp[:, w], U32MAX)
-                    for w in range(W))
-        slot = jnp.arange(FCAP, dtype=jnp.int32)
-        sorted_ops = lax.optimization_barrier(
-            lax.sort(kws + (slot,), num_keys=W + 1))
-        sk, sslot = sorted_ops[:W], sorted_ops[W]
-        # first of each equal-key run = smallest slot (slot is the
-        # final sort key), i.e. the oracle's first-seen survivor
-        diff = jnp.zeros(FCAP, bool).at[0].set(True)
-        for w in range(W):
-            diff = diff | jnp.concatenate(
-                [jnp.ones(1, bool), sk[w][1:] != sk[w][:-1]])
-        is_sent = jnp.ones(FCAP, bool)
-        for w in range(W):
-            is_sent = is_sent & (sk[w] == U32MAX)
-        surv = diff & ~is_sent
-        # membership probes against the visited set and the level set
-        surv = surv & ~self._member(carry["vis"], sk)
-        surv = surv & ~self._member(carry["lvlk"], sk)
-
-        surv = surv & ~self._member(carry["ltail"], sk)
-
-        fresh = jnp.zeros(FCAP, bool).at[sslot].set(surv)  # slot order
+        keys = tuple(jnp.where(elive, fp[:, w], U32MAX)
+                     for w in range(W))
+        # any overflow means this level replays — stop inserting so the
+        # journal stays the exact record of this level's table writes
+        gate = ~(carry["ovf"] | fovf | carry["hovf"])
+        ranks = jnp.arange(FCAP, dtype=jnp.uint32)
+        table, claims, fresh, pos, hv = self._probe_insert(
+            carry["vis"], carry["claims"], keys, elive & gate, ranks)
+        hovf = carry["hovf"] | hv
         n_fresh = fresh.sum(dtype=jnp.int32)
+        ovf_now = carry["n_lvl"] + n_fresh > LCAP - FCAP
+        # level buffer would overflow: revert THIS chunk's inserts on
+        # the spot (earlier chunks' stay until finalize's abandon
+        # clears them via the journal), then skip the append
+        ridx = jnp.where(fresh & ovf_now, pos, VCAP)
+        table = tuple(table[w].at[ridx].set(U32MAX, mode="drop")
+                      for w in range(W))
+        fresh = fresh & ~ovf_now
+        n_fresh = jnp.where(ovf_now, 0, n_fresh)
+        ovf = carry["ovf"] | ovf_now
+
+        slot = jnp.arange(FCAP, dtype=jnp.int32)
         lpos = jnp.where(fresh,
                          jnp.cumsum(fresh.astype(jnp.int32)) - 1, FCAP)
-        lidx, lkey = lax.optimization_barrier((
+        lidx = lax.optimization_barrier(
             jnp.zeros((FCAP,), jnp.int32).at[lpos].set(
-                slot, mode="drop"),                      # out slot -> slot
-            tuple(jnp.full((FCAP,), U32MAX).at[lpos].set(
-                kws[w], mode="drop") for w in range(W))))
+                slot, mode="drop"))                      # out slot -> slot
 
         # contiguous append at n_lvl: gather FCAP rows, one
         # dynamic_update_slice per array.  Rows past n_fresh are
@@ -371,70 +525,67 @@ class Engine:
         # clamp only engages when the level has overflowed, in which
         # case ovf forces a replay anyway.
         start = jnp.minimum(carry["n_lvl"], LCAP - FCAP)
-        ovf = carry["ovf"] | (carry["n_lvl"] + n_fresh > LCAP - FCAP)
         lane = take[lidx]                                # original lane id
-        lvl = {k: lax.dynamic_update_slice_in_dim(
-            v, cand_c[k][lidx], start, 0)
-            for k, v in carry["lvl"].items()}
+        rows = lax.optimization_barrier(
+            {k: cand_c[k][lidx] for k in cand_c})
+        # invariants + constraints on the fresh rows (garbage rows are
+        # masked by n_lvl at finalize)
+        inv, con = lax.optimization_barrier(self._phase2_impl(rows))
+        lvl = {k: lax.dynamic_update_slice_in_dim(v, rows[k], start, 0)
+               for k, v in carry["lvl"].items()}
         # parent global ids are arithmetic: frontier row r has id
         # pg_off + r (the frontier IS the previous level, uncompacted)
         lpar = lax.dynamic_update_slice_in_dim(
             carry["lpar"], carry["pg_off"] + base + lane // A, start, 0)
         llane = lax.dynamic_update_slice_in_dim(
             carry["llane"], lane % A, start, 0)
-        # two-tier level key set (LSM-style): fresh keys merge into the
-        # small sorted tail each chunk (O(TCAP)); the tail spills into
-        # the big sorted run only when nearly full, so the O(LCAP)
-        # merge is amortized over many chunks instead of paid per chunk
-        TCAP = carry["ltail"][0].shape[0]
-        spill = carry["n_tail"] + n_fresh > TCAP
-
-        def do_spill(ops):
-            lvlk, ltail = ops
-            return (self._sorted_insert(lvlk, ltail, LCAP),
-                    tuple(jnp.full((TCAP,), U32MAX)
-                          for _ in range(W)))
-
-        def no_spill(ops):
-            return ops
-
-        lvlk, ltail = lax.cond(spill, do_spill, no_spill,
-                               (carry["lvlk"], carry["ltail"]))
-        n_tail = jnp.where(spill, 0, carry["n_tail"]) + n_fresh
-        ltail = self._sorted_insert(ltail, lkey, TCAP)
-        return dict(carry, lvl=lvl, lpar=lpar, llane=llane, lvlk=lvlk,
-                    ltail=ltail, n_tail=n_tail,
+        jslot = lax.dynamic_update_slice_in_dim(
+            carry["jslot"], pos[lidx], start, 0)
+        linv = lax.dynamic_update_slice(carry["linv"], inv, (start, 0))
+        lcon = lax.dynamic_update_slice_in_dim(
+            carry["lcon"], con, start, 0)
+        return dict(carry, vis=table, claims=claims, lvl=lvl, lpar=lpar,
+                    llane=llane, jslot=jslot, linv=linv, lcon=lcon,
                     n_lvl=jnp.minimum(carry["n_lvl"] + n_fresh,
                                       LCAP - FCAP),
-                    n_gen=n_gen, ovf=ovf, fovf=fovf,
+                    n_gen=n_gen, ovf=ovf, fovf=fovf, hovf=hovf,
                     base=base + B)
 
     # ------------------------------------------------------------------
-    # per-level finalize: invariants/constraints on the new states,
-    # next-frontier compaction, visited merge — one device call
+    # per-level finalize: scalar aggregation, next-frontier swap,
+    # journal rollback on overflow — one cheap device call.
+    #
+    # (A whole-level while_loop driver was tried and reverted for the
+    # single-device engine: XLA materializes padded-layout copies of
+    # the loop-carried [LCAP, S, S]-shaped buffers — (3,3) minor dims
+    # tile to (4,128), a 57x blowup that OOMs HBM at LCAP=2^21 — and
+    # measured host dispatch is only ~0.5 ms/chunk, so per-chunk
+    # dispatch costs nothing.  The sharded engine keeps its level
+    # driver: shard_map dispatch is genuinely expensive and its
+    # per-device LB is D-fold smaller.)
     # ------------------------------------------------------------------
 
     def _finalize_impl(self, carry):
         """Level finalize.  Returns (carry', outputs) where
         outputs["scal"] packs every per-level scalar the host needs —
-        [n_lvl, n_viol, faults, n_front, ovf, fovf, n_gen] — into ONE
-        int32 array so the level costs a single device→host round trip
-        (the tunneled-TPU transfer latency is ~100ms; it used to be
-        paid 5× per level).  When a chunk overflowed a buffer (ovf /
-        fovf), the commit branch is skipped on device: the visited set
-        and frontier stay untouched and the level buffer resets, so the
-        host can grow capacities and replay the level exactly."""
+        [n_lvl, n_viol, faults, n_front, ovf, fovf, n_gen, n_expand,
+        hovf] — into ONE int32 array so the level costs a single
+        device→host round trip (the tunneled-TPU transfer latency is
+        ~100ms).  Invariants/constraints were already evaluated per
+        chunk (linv/lcon rows); finalize only aggregates, swaps the
+        level buffer into the frontier, and — when a chunk overflowed a
+        buffer (ovf/fovf/hovf) — rolls the visited table back via the
+        journal instead of committing, so the host can grow capacities
+        and replay the level exactly."""
         LCAP = carry["lpar"].shape[0]
         VCAP = carry["vis"][0].shape[0]
         n_lvl = carry["n_lvl"]
         g_off = carry["g_off"]
-        bad = carry["ovf"] | carry["fovf"]
+        bad = carry["ovf"] | carry["fovf"] | carry["hovf"]
         validrow = jnp.arange(LCAP, dtype=jnp.int32) < n_lvl
-        # barrier for the same reason as the chunk step: stop XLA from
-        # rematerializing the predicate graphs into each consumer
-        inv, con = lax.optimization_barrier(
-            self._phase2_impl(carry["lvl"]))
-        inv_ok = inv | ~validrow[:, None] if self.inv_names else inv
+        inv_ok = (carry["linv"] | ~validrow[:, None]
+                  if self.inv_names else carry["linv"])
+        con = carry["lcon"]
         n_viol = (~inv_ok).sum(dtype=jnp.int32)
         faults = ((carry["lvl"]["ctr"][:, C_OVERFLOW] > 0) &
                   validrow).sum(dtype=jnp.int32)
@@ -443,38 +594,34 @@ class Engine:
             # the level buffer BECOMES the frontier (pointer swap, free
             # under donation); constraint-pruned rows stay in place and
             # are masked out of expansion by fmask (prune-not-expand,
-            # SURVEY §2.8) so no LCAP-wide compaction gather is needed
+            # SURVEY §2.8) so no LCAP-wide compaction gather is needed.
+            # The level's keys are already in the visited table.
             fmask = con & validrow
-            vis = self._sorted_insert(
-                carry["vis"],
-                tuple(jnp.concatenate([carry["lvlk"][w],
-                                       carry["ltail"][w]])
-                      for w in range(self.W)),
-                VCAP)
             return (carry["lvl"], carry["front"], fmask, n_lvl,
-                    vis, g_off, g_off + n_lvl)
+                    carry["vis"], g_off, g_off + n_lvl)
 
         def abandon(carry):
-            # overflow: leave frontier/visited intact for the replay
+            # overflow: roll the visited table back to the level start
+            # by clearing exactly the journaled inserts (safe — see
+            # _probe_insert rollback note), leave the frontier intact
+            cidx = jnp.where(validrow, carry["jslot"], VCAP)
+            vis = tuple(carry["vis"][w].at[cidx].set(U32MAX, mode="drop")
+                        for w in range(self.W))
             return (carry["front"], carry["lvl"], carry["fmask"],
-                    carry["n_front"], carry["vis"], carry["pg_off"],
-                    g_off)
+                    carry["n_front"], vis, carry["pg_off"], g_off)
 
         front, lvl, fmask, n_front, vis, pg_off, g_next = lax.cond(
             bad, abandon, commit, carry)
-        lvlk = tuple(jnp.full((LCAP,), U32MAX) for _ in range(self.W))
-        ltail = tuple(jnp.full((carry["ltail"][0].shape[0],), U32MAX)
-                      for _ in range(self.W))
         n_expand = (con & validrow).sum(dtype=jnp.int32)
         scal = jnp.stack([
             n_lvl, n_viol, faults, n_front,
             carry["ovf"].astype(jnp.int32), carry["fovf"].astype(jnp.int32),
-            carry["n_gen"], n_expand])
-        new_carry = dict(carry, vis=vis, lvlk=lvlk, ltail=ltail,
-                         n_tail=jnp.int32(0), front=front, lvl=lvl,
+            carry["n_gen"], n_expand, carry["hovf"].astype(jnp.int32)])
+        new_carry = dict(carry, vis=vis, front=front, lvl=lvl,
                          fmask=fmask, n_front=n_front,
                          n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
                          ovf=jnp.bool_(False), fovf=jnp.bool_(False),
+                         hovf=jnp.bool_(False),
                          base=jnp.int32(0), pg_off=pg_off, g_off=g_next)
         return new_carry, dict(inv_ok=inv_ok, scal=scal)
 
@@ -485,17 +632,18 @@ class Engine:
         one = encode(self.lay, *init_state(self.cfg))
         zeros = {k: jnp.zeros((lcap,) + v.shape, dtype=v.dtype)
                  for k, v in one.items()}
-        sent = tuple(jnp.full((lcap,), U32MAX) for _ in range(self.W))
-        tcap = min(8 * fcap, lcap)
+        n_inv = len(self.inv_names)
         return dict(
+            # the open-addressing visited table + its transient claims
             vis=tuple(jnp.full((vcap,), U32MAX) for _ in range(self.W)),
-            lvlk=sent,
-            ltail=tuple(jnp.full((tcap,), U32MAX) for _ in range(self.W)),
-            n_tail=jnp.int32(0),
+            claims=jnp.full((vcap,), U32MAX),
+            jslot=jnp.full((lcap,), -1, jnp.int32),  # level insert journal
+            linv=jnp.ones((lcap, n_inv), bool),      # per-row invariants
+            lcon=jnp.ones((lcap,), bool),            # per-row constraints
             lvl=zeros,
             lpar=jnp.full((lcap,), -1, jnp.int32),
             llane=jnp.full((lcap,), -1, jnp.int32),
-            cidx=jnp.zeros((fcap,), jnp.int32),   # chunk-compaction scratch
+            cidx=jnp.zeros((fcap,), jnp.int32),   # FCAP shape anchor
             n_lvl=jnp.int32(0),
             n_gen=jnp.int32(0),
             base=jnp.int32(0),      # chunk cursor within the frontier
@@ -503,18 +651,23 @@ class Engine:
             pg_off=jnp.int32(0),    # global state-id offset (frontier)
             ovf=jnp.bool_(False),
             fovf=jnp.bool_(False),
+            hovf=jnp.bool_(False),  # probe-round budget blown
             front={k: jnp.zeros_like(v) for k, v in zeros.items()},
             fmask=jnp.zeros((lcap,), bool),
             n_front=jnp.int32(0),
         )
 
     def _grow(self, carry, lcap: int, vcap: int):
-        """Re-home a carry into bigger capacity buffers (visited keys and
-        the frontier survive; the level buffer is reset — callers replay
-        the level)."""
+        """Re-home a carry into bigger capacity buffers (the visited
+        table and the frontier survive; the level buffer is reset —
+        callers replay the level).  The table must already have `vcap`
+        slots (_rehash_tables handles table growth)."""
         old_lcap = carry["lpar"].shape[0]
+        assert carry["vis"][0].shape[0] == vcap, \
+            "grow the table via _rehash_tables first"
         new = self._fresh_carry(lcap, vcap, self.FCAP)
-        new["vis"] = self._grow_vis(carry, vcap)["vis"]
+        new["vis"] = carry["vis"]
+        new["claims"] = carry["claims"]
         pad = lcap - old_lcap
         new["front"] = {k: jnp.concatenate(
             [carry["front"][k], jnp.zeros((pad,) + v.shape[1:], v.dtype)])
@@ -578,22 +731,39 @@ class Engine:
                               generated_states=n_roots, depth=0)
             while self.LCAP - self.FCAP < 2 * n_roots:
                 self.LCAP *= 2
+            while n_roots + self.LCAP - self.FCAP > \
+                    self._LOAD_MAX * self.VCAP:
+                self.VCAP *= 4
             carry = self._fresh_carry(self.LCAP, self.VCAP)
             # roots enter through the same admit path as every level:
-            # place them in the level buffer and finalize.
+            # place them in the level buffer + visited table (host-side
+            # probe placement — the table is empty, so the sequential
+            # simulation is exact) and finalize.
             pad = self.LCAP - n_roots
             carry["lvl"] = {k: jnp.asarray(np.concatenate(
                 [roots[k], np.zeros((pad,) + roots[k].shape[1:],
                                     roots[k].dtype)]))
                 for k in roots}
             rk = np.asarray(root_fp[first_idx], dtype=np.uint32)
-            # lexicographic row sort (np.lexsort: LAST key is primary)
-            order = np.lexsort(tuple(rk[:, w]
-                                     for w in range(self.W - 1, -1, -1)))
-            carry["lvlk"] = tuple(jnp.asarray(np.concatenate(
-                [rk[order, w], np.full(pad, 0xFFFFFFFF, np.uint32)]))
+            slots = self._host_probe_assign(rk)
+            sl = jnp.asarray(slots)
+            carry["vis"] = tuple(
+                carry["vis"][w].at[sl].set(jnp.asarray(rk[:, w]))
                 for w in range(self.W))
+            jslot = np.full((self.LCAP,), -1, np.int32)
+            jslot[:n_roots] = slots
+            carry["jslot"] = jnp.asarray(jslot)
             carry["n_lvl"] = jnp.int32(n_roots)
+            # invariants/constraints for the root cohort (levels get
+            # theirs inside the chunk step; roots bypass it)
+            inv_r, con_r = self._phase2(
+                {k: jnp.asarray(roots[k]) for k in roots})
+            linv = np.ones((self.LCAP, len(self.inv_names)), bool)
+            linv[:n_roots] = np.asarray(inv_r)
+            lcon = np.ones((self.LCAP,), bool)
+            lcon[:n_roots] = np.asarray(con_r)
+            carry["linv"] = jnp.asarray(linv)
+            carry["lcon"] = jnp.asarray(lcon)
             n_states = 0
             n_vis = 0
             depth = 0
@@ -601,22 +771,28 @@ class Engine:
         t_dev = 0.0
 
         def run_finalize(carry):
-            # pessimistic growth: a level can add at most LCAP - FCAP
-            # keys, so growing on the bound needs no mid-level sync
-            need = n_vis + self.LCAP - self.FCAP
-            if need > self.VCAP:
-                while self.VCAP < need:
-                    self.VCAP *= 4
-                carry = self._grow_vis(carry, self.VCAP)
             carry, out = self._fin_jit(carry)
             # the ONE per-level device->host sync
             return carry, out, [int(x) for x in np.asarray(out["scal"])]
+
+        def grow_table_if_needed(carry):
+            # pessimistic load bound: a level can add at most
+            # LCAP - FCAP keys, so checking before the level needs no
+            # mid-level sync
+            need = n_vis + self.LCAP - self.FCAP
+            if need > self._LOAD_MAX * self.VCAP:
+                while need > self._LOAD_MAX * self.VCAP:
+                    self.VCAP *= 4
+                vis, claims = self._rehash_tables(carry["vis"], self.VCAP)
+                carry = dict(carry, vis=vis, claims=claims)
+            return carry
 
         def harvest(carry, out, scal):
             """Per-level host bookkeeping: counts, parents/lanes,
             violations, optional state store."""
             nonlocal n_states, n_vis
-            n_lvl, n_viol, faults, n_front, _, _, n_genl, _ = scal
+            n_lvl, n_viol, faults, n_front = scal[:4]
+            n_genl = scal[6]
             res.distinct_states += n_lvl
             res.overflow_faults += faults
             res.generated_states += n_genl
@@ -660,30 +836,46 @@ class Engine:
                 res.distinct_states < max_states:
             depth += 1
             t1 = time.time()
+            carry = grow_table_if_needed(carry)
             while True:
                 n_chunks = (n_front + self.chunk - 1) // self.chunk
                 for _ in range(n_chunks):
                     carry = self._step_jit(carry)
                 carry, out, scal = run_finalize(carry)
-                ovf, fovf = bool(scal[4]), bool(scal[5])
-                if not (ovf or fovf):
+                ovf, fovf, hovf = (bool(scal[4]), bool(scal[5]),
+                                   bool(scal[8]))
+                if not (ovf or fovf or hovf):
                     break
-                # buffer overflow: the finalize skipped its commit on
-                # device (frontier + visited intact), so grow and
-                # replay the level exactly.  Growth is 4x — each growth
-                # step recompiles the fused kernels, so fewer, larger
-                # steps.
+                # buffer overflow: the finalize rolled the table back
+                # and skipped its commit on device (frontier intact),
+                # so grow and replay the level exactly.  Growth is 4x —
+                # each growth step recompiles the fused kernels, so
+                # fewer, larger steps.
+                old_caps = (self.LCAP, self.FCAP)
                 if fovf:
                     self.FCAP *= 4
                 if ovf or self.LCAP < 4 * self.FCAP:
                     self.LCAP = self._round_cap(
                         max((4 * self.LCAP) if ovf else self.LCAP,
                             4 * self.FCAP))
+                if hovf:
+                    # probe walk blew its round budget: table too full
+                    self.VCAP *= 4
+                    vis, claims = self._rehash_tables(carry["vis"],
+                                                      self.VCAP)
+                    carry = dict(carry, vis=vis, claims=claims)
                 if verbose:
                     print(f"level {depth}: buffer overflow "
-                          f"({'level' if ovf else 'chunk'}), growing "
-                          f"LCAP={self.LCAP} FCAP={self.FCAP}")
-                carry = self._grow(carry, self.LCAP, self.VCAP)
+                          f"(ovf={ovf} fovf={fovf} hovf={hovf}), "
+                          f"LCAP={self.LCAP} FCAP={self.FCAP} "
+                          f"VCAP={self.VCAP}")
+                if (self.LCAP, self.FCAP) != old_caps:
+                    carry = self._grow(carry, self.LCAP, self.VCAP)
+                    # the replayed level can now add up to the NEW
+                    # LCAP - FCAP keys: re-check the table load bound
+                    # before replaying (a full table would spin the
+                    # probe walk to its round budget)
+                    carry = grow_table_if_needed(carry)
             n_front = harvest(carry, out, scal)
             if scal[0] == 0 and scal[6] == 0:
                 # the frontier had only constraint-pruned rows: nothing
@@ -711,15 +903,6 @@ class Engine:
         res.seconds = time.time() - t0
         res.phase_seconds["device_levels"] = t_dev
         return res
-
-    def _grow_vis(self, carry, vcap: int):
-        ovcap = carry["vis"][0].shape[0]
-        carry = dict(carry)
-        carry["vis"] = tuple(
-            jnp.concatenate([carry["vis"][w],
-                             jnp.full((vcap - ovcap,), U32MAX)])
-            for w in range(self.W))
-        return carry
 
     # ------------------------------------------------------------------
     # checkpoint / resume (TLC checkpoints to states/ —
@@ -768,14 +951,25 @@ class Engine:
     def _load_checkpoint(self, path):
         import json
         z = np.load(path, allow_pickle=False)
+        if "meta" not in z:
+            raise CheckpointError(f"{path}: not an engine checkpoint "
+                                  "(no meta record)")
         meta = json.loads(str(z["meta"]))
+        for key in ("cfg", "chunk", "LCAP", "VCAP", "FCAP",
+                    "store_states", "n_levels", "distinct", "generated",
+                    "depth", "level_sizes", "faults"):
+            if key not in meta:
+                raise CheckpointError(
+                    f"{path}: checkpoint written by an older engine "
+                    f"version (meta lacks {key!r}) — re-run without "
+                    "--resume")
         if meta["cfg"] != repr(self.cfg):
-            raise ValueError(
+            raise CheckpointError(
                 "checkpoint was written for a different model config:\n"
                 f"  checkpoint: {meta['cfg']}\n"
                 f"  engine:     {self.cfg!r}")
         if meta["chunk"] != self.chunk:
-            raise ValueError(
+            raise CheckpointError(
                 f"checkpoint was written with chunk={meta['chunk']}; "
                 f"resume with the same chunk (engine has {self.chunk} — "
                 "capacities are rounded to the chunk size)")
@@ -787,11 +981,19 @@ class Engine:
         template = jax.eval_shape(
             lambda: self._fresh_carry(self.LCAP, self.VCAP, self.FCAP))
         leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+        missing = [_leaf_name(kp) for kp, _ in leaves
+                   if _leaf_name(kp) not in z]
+        if missing:
+            raise CheckpointError(
+                f"{path}: checkpoint carry layout is from an "
+                f"incompatible engine version (missing {missing[:3]}"
+                f"{'…' if len(missing) > 3 else ''}) — re-run without "
+                "--resume")
         vals = [jnp.asarray(z[_leaf_name(kp)]) for kp, _ in leaves]
         carry = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(template), vals)
         if self.store_states and not meta["store_states"]:
-            raise ValueError(
+            raise CheckpointError(
                 "checkpoint was written with store_states=False; "
                 "resume with store_states=False (CLI: --no-store) — "
                 "trace archives cannot be reconstructed")

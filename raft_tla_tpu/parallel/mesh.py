@@ -12,11 +12,13 @@ counterpart implemented here:
   candidates (compute data parallelism);
 - every candidate is then routed to its OWNER device — owner = low
   bits of the fingerprint — via ``jax.lax.all_to_all`` over ICI; the
-  owner probes its visited/level shards, dedups, and appends fresh
-  states to its level shard.  The dedup authority therefore lives on
-  device and is partitioned by hash, exactly like TLC's worker-local
-  fingerprint table partitions, with the all-to-all exchange riding
-  ICI instead of shared memory;
+  owner claim-inserts into its shard of the open-addressing visited
+  table (engine/bfs._probe_insert: membership + first-seen dedup +
+  insert in one probe walk), and appends fresh states to its level
+  shard.  The dedup authority therefore lives on device and is
+  partitioned by hash, exactly like TLC's worker-local fingerprint
+  table partitions, with the all-to-all exchange riding ICI instead
+  of shared memory;
 - because ownership is hash-uniform, the next frontier (the level
   buffer, swapped in place) is automatically load-balanced.
 
@@ -85,9 +87,11 @@ class ShardedEngine(Engine):
         self.BL = chunk // self.D              # frontier rows per device
         super().__init__(cfg, chunk=chunk, store_states=store_states,
                          lcap=lcap, vcap=vcap, fcap=fcap)
-        # per-device capacities
+        # per-device capacities.  VB (table shard slots) power of two
+        # for mask indexing.
         self.FC = max(256, (self.FCAP + self.D - 1) // self.D)
-        self.VB = max(1 << 12, vcap // self.D)
+        self.VB = 1 << max(12, int(np.ceil(np.log2(
+            max(vcap // self.D, 2)))))
         # send capacity per (src, dst) pair; hash-uniform routing puts
         # ~FC/D candidates per destination — 4x headroom, growable
         self.SC = int(scap) if scap else max(256, 4 * self.FC // self.D)
@@ -95,42 +99,50 @@ class ShardedEngine(Engine):
         # its usable capacity
         self.LB = self._round_lb(max(lcap // self.D, 4 * self.FC,
                                      2 * self.D * self.SC))
-        self._set_tb()
-        self._step_jit = jax.jit(self._sharded_step_call,
-                                 donate_argnums=0)
         self._fin_jit = jax.jit(self._sharded_fin_call, donate_argnums=0)
+        self._level_jit = jax.jit(self._sharded_level_call,
+                                  donate_argnums=0)
 
     def _round_lb(self, n: int) -> int:
         b = self.BL
         return ((int(n) + b - 1) // b) * b
 
-    def _set_tb(self):
-        # the tail must hold a full per-step receive window (n_fresh
-        # can reach M = D*SC); a too-small tail would silently drop
-        # keys in _sorted_insert and re-admit duplicate states
-        self.TB = min(max(8 * self.FC, self.D * self.SC), self.LB)
-
     # -----------------------------------------------------------------
-    def _sharded_step_call(self, carry):
-        specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
-        return _shard_map(self._shard_step, self.mesh,
-                          (specs,), specs)(carry)
-
     def _sharded_fin_call(self, carry):
         specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
         out_specs = (specs, dict(inv_ok=P("d"), scal=P("d")))
         return _shard_map(self._shard_finalize, self.mesh,
                           (specs,), out_specs)(carry)
 
-    # -----------------------------------------------------------------
-    # per-device chunk step (runs inside shard_map; leading axis of
-    # every leaf is the local shard, size 1 in the device dimension)
-    # -----------------------------------------------------------------
+    def _sharded_level_call(self, carry):
+        specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
+        out_specs = (specs, dict(inv_ok=P("d"), scal=P("d")))
+        return _shard_map(self._shard_level, self.mesh,
+                          (specs,), out_specs)(carry)
 
-    def _shard_step(self, carry):
+    def _shard_level(self, carry):
+        """Whole BFS level in one device call (sharded twin of
+        engine/bfs._level_impl): while any device still has frontier
+        rows and no device overflowed, run lock-step chunk steps (the
+        all_to_all inside needs every device participating — drained
+        shards keep stepping with all-invalid rows), then finalize."""
         c = jax.tree_util.tree_map(lambda x: x[0], carry)
-        c = self._local_step(c)
-        return jax.tree_util.tree_map(lambda x: x[None], c)
+
+        def cond(c):
+            more = c["base"] < c["n_front"]
+            bad = c["ovf"] | c["fovf"] | c["sovf"] | c["hovf"]
+            flags = jax.lax.all_gather(jnp.stack([more, bad]), "d")
+            return flags[:, 0].any() & ~flags[:, 1].any()
+
+        c = lax.while_loop(cond, self._local_step, c)
+        new_c, out = self._local_finalize(c)
+        return (jax.tree_util.tree_map(lambda x: x[None], new_c),
+                jax.tree_util.tree_map(lambda x: x[None], out))
+
+    # -----------------------------------------------------------------
+    # per-device chunk step (runs inside _shard_level's while_loop; all
+    # leaves are the local shard, device axis stripped)
+    # -----------------------------------------------------------------
 
     def _local_step(self, c):
         B, A, W, D = self.BL, self.A, self.W, self.D
@@ -208,75 +220,74 @@ class ShardedEngine(Engine):
         recv_pgid = a2a(send_pgid)
         recv_lane = a2a(send_lane)
 
-        # ---- owner-side dedup (first-seen in arrival-slot order) ----
-        ridx = jnp.arange(M, dtype=jnp.int32)
-        sorted_ops = lax.optimization_barrier(
-            lax.sort(recv_key + (ridx,), num_keys=W + 1))
-        sk, srid = sorted_ops[:W], sorted_ops[W]
-        diff = jnp.zeros(M, bool).at[0].set(True)
+        # ---- owner-side dedup: claim-insert into the table shard ----
+        # (first-seen in arrival-slot order — the rank tie-break; same
+        # multi-worker nondeterminism caveat as the module docstring)
+        VB = c["vis"][0].shape[0]
+        recv_live = jnp.zeros(M, bool)
         for w in range(W):
-            diff = diff | jnp.concatenate(
-                [jnp.ones(1, bool), sk[w][1:] != sk[w][:-1]])
-        is_sent = jnp.ones(M, bool)
-        for w in range(W):
-            is_sent = is_sent & (sk[w] == U32MAX)
-        surv = diff & ~is_sent
-        surv = surv & ~self._member(c["vis"], sk)
-        surv = surv & ~self._member(c["lvlk"], sk)
-        surv = surv & ~self._member(c["ltail"], sk)
-
-        fresh = jnp.zeros(M, bool).at[srid].set(surv)
+            recv_live = recv_live | (recv_key[w] != U32MAX)
+        gate = ~(c["ovf"] | c["fovf"] | c["sovf"] | c["hovf"])
+        ranks = jnp.arange(M, dtype=jnp.uint32)
+        table, claims, fresh, pos, hv = self._probe_insert(
+            c["vis"], c["claims"], recv_key, recv_live & gate, ranks)
+        hovf = c["hovf"] | hv
         n_fresh = fresh.sum(dtype=jnp.int32)
+        ovf_now = c["n_lvl"] + n_fresh > LB - M
+        # level shard would overflow: revert this step's inserts and
+        # skip the append (the level replays; see engine/bfs)
+        ridx2 = jnp.where(fresh & ovf_now, pos, VB)
+        table = tuple(table[w].at[ridx2].set(U32MAX, mode="drop")
+                      for w in range(W))
+        fresh = fresh & ~ovf_now
+        n_fresh = jnp.where(ovf_now, 0, n_fresh)
+        ovf = c["ovf"] | ovf_now
+
+        ridx = jnp.arange(M, dtype=jnp.int32)
         lpos = jnp.where(fresh,
                          jnp.cumsum(fresh.astype(jnp.int32)) - 1, M)
-        lidx, lkey = lax.optimization_barrier((
-            jnp.zeros((M,), jnp.int32).at[lpos].set(ridx, mode="drop"),
-            tuple(jnp.full((M,), U32MAX).at[lpos].set(
-                recv_key[w], mode="drop") for w in range(W))))
+        lidx = lax.optimization_barrier(
+            jnp.zeros((M,), jnp.int32).at[lpos].set(ridx, mode="drop"))
 
         start = jnp.minimum(c["n_lvl"], LB - M)
-        ovf = c["ovf"] | (c["n_lvl"] + n_fresh > LB - M)
-        lvl = {k: lax.dynamic_update_slice_in_dim(
-            v, recv_row[k][lidx], start, 0)
-            for k, v in c["lvl"].items()}
+        rows = lax.optimization_barrier(
+            {k: recv_row[k][lidx] for k in recv_row})
+        inv, con = lax.optimization_barrier(self._phase2_impl(rows))
+        lvl = {k: lax.dynamic_update_slice_in_dim(v, rows[k], start, 0)
+               for k, v in c["lvl"].items()}
         lpar = lax.dynamic_update_slice_in_dim(
             c["lpar"], recv_pgid[lidx], start, 0)
         llane = lax.dynamic_update_slice_in_dim(
             c["llane"], recv_lane[lidx], start, 0)
-
-        TB = c["ltail"][0].shape[0]
-        ovf = ovf | (n_fresh > TB)     # belt: TB >= M should hold
-        spill = c["n_tail"] + n_fresh > TB
-
-        def do_spill(ops):
-            lvlk, ltail = ops
-            return (self._sorted_insert(lvlk, ltail, LB),
-                    tuple(jnp.full((TB,), U32MAX) for _ in range(W)))
-
-        lvlk, ltail = lax.cond(spill, do_spill, lambda o: o,
-                               (c["lvlk"], c["ltail"]))
-        n_tail = jnp.where(spill, 0, c["n_tail"]) + n_fresh
-        ltail = self._sorted_insert(ltail, lkey, TB)
-        return dict(c, lvl=lvl, lpar=lpar, llane=llane, lvlk=lvlk,
-                    ltail=ltail, n_tail=n_tail,
+        jslot = lax.dynamic_update_slice_in_dim(
+            c["jslot"], pos[lidx], start, 0)
+        linv = lax.dynamic_update_slice(c["linv"], inv, (start, 0))
+        lcon = lax.dynamic_update_slice_in_dim(c["lcon"], con, start, 0)
+        return dict(c, vis=table, claims=claims, lvl=lvl, lpar=lpar,
+                    llane=llane, jslot=jslot, linv=linv, lcon=lcon,
                     n_lvl=jnp.minimum(c["n_lvl"] + n_fresh, LB - M),
                     n_gen=n_gen, ovf=ovf, fovf=fovf, sovf=sovf,
-                    base=base + B)
+                    hovf=hovf, base=base + B)
 
     # -----------------------------------------------------------------
 
     def _shard_finalize(self, carry):
         c = jax.tree_util.tree_map(lambda x: x[0], carry)
+        new_c, out = self._local_finalize(c)
+        return (jax.tree_util.tree_map(lambda x: x[None], new_c),
+                jax.tree_util.tree_map(lambda x: x[None], out))
+
+    def _local_finalize(self, c):
         LB = c["fmask"].shape[0]
         VB = c["vis"][0].shape[0]
         n_lvl = c["n_lvl"]
-        bad_local = c["ovf"] | c["fovf"] | c["sovf"]
+        bad_local = c["ovf"] | c["fovf"] | c["sovf"] | c["hovf"]
         # any device overflowing aborts the level everywhere
         bad = jax.lax.all_gather(bad_local, "d").any()
         validrow = jnp.arange(LB, dtype=jnp.int32) < n_lvl
-        inv, con = lax.optimization_barrier(
-            self._phase2_impl(c["lvl"]))
-        inv_ok = inv | ~validrow[:, None] if self.inv_names else inv
+        inv_ok = (c["linv"] | ~validrow[:, None]
+                  if self.inv_names else c["linv"])
+        con = c["lcon"]
         n_viol = (~inv_ok).sum(dtype=jnp.int32)
         faults = ((c["lvl"]["ctr"][:, C_OVERFLOW] > 0) &
                   validrow).sum(dtype=jnp.int32)
@@ -288,51 +299,49 @@ class ShardedEngine(Engine):
         total = nl_vec.sum()
 
         def commit(c):
+            # the level's keys are already in the table shard
             fmask = con & validrow
-            ins = tuple(jnp.concatenate([c["lvlk"][w], c["ltail"][w]])
-                        for w in range(self.W))
-            vis = self._sorted_insert(c["vis"], ins, VB)
-            return (c["lvl"], c["front"], fmask, n_lvl, vis,
+            return (c["lvl"], c["front"], fmask, n_lvl, c["vis"],
                     c["g_off"] + prefix[d_idx], c["g_off"] + total)
 
         def abandon(c):
+            # roll the table shard back via the journal (engine/bfs
+            # _probe_insert rollback note)
+            cidx = jnp.where(validrow, c["jslot"], VB)
+            vis = tuple(c["vis"][w].at[cidx].set(U32MAX, mode="drop")
+                        for w in range(self.W))
             return (c["front"], c["lvl"], c["fmask"], c["n_front"],
-                    c["vis"], c["pg_off"], c["g_off"])
+                    vis, c["pg_off"], c["g_off"])
 
         front, lvl, fmask, n_front, vis, pg_off, g_next = lax.cond(
             bad, abandon, commit, c)
-        lvlk = tuple(jnp.full((LB,), U32MAX) for _ in range(self.W))
-        ltail = tuple(jnp.full((c["ltail"][0].shape[0],), U32MAX)
-                      for _ in range(self.W))
         scal = jnp.stack([
             n_lvl, n_viol, faults, n_front,
             c["ovf"].astype(jnp.int32), c["fovf"].astype(jnp.int32),
             c["n_gen"], (con & validrow).sum(dtype=jnp.int32),
-            c["sovf"].astype(jnp.int32)])
-        new_c = dict(c, vis=vis, lvlk=lvlk, ltail=ltail,
-                     n_tail=jnp.int32(0), front=front, lvl=lvl,
+            c["sovf"].astype(jnp.int32), c["hovf"].astype(jnp.int32)])
+        new_c = dict(c, vis=vis, front=front, lvl=lvl,
                      fmask=fmask, n_front=n_front,
                      n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
                      ovf=jnp.bool_(False), fovf=jnp.bool_(False),
-                     sovf=jnp.bool_(False),
+                     sovf=jnp.bool_(False), hovf=jnp.bool_(False),
                      base=jnp.int32(0), pg_off=pg_off, g_off=g_next)
-        out = dict(inv_ok=inv_ok, scal=scal)
-        return (jax.tree_util.tree_map(lambda x: x[None], new_c),
-                jax.tree_util.tree_map(lambda x: x[None], out))
+        return new_c, dict(inv_ok=inv_ok, scal=scal)
 
     # -----------------------------------------------------------------
 
     def _fresh_sharded_carry(self):
-        D, LB, VB, TB, FC = self.D, self.LB, self.VB, self.TB, self.FC
+        D, LB, VB, FC = self.D, self.LB, self.VB, self.FC
         one = encode(self.lay, *init_state(self.cfg))
         zeros = {k: jnp.zeros((D, LB) + v.shape, dtype=v.dtype)
                  for k, v in one.items()}
+        n_inv = len(self.inv_names)
         return dict(
             vis=tuple(jnp.full((D, VB), U32MAX) for _ in range(self.W)),
-            lvlk=tuple(jnp.full((D, LB), U32MAX) for _ in range(self.W)),
-            ltail=tuple(jnp.full((D, TB), U32MAX)
-                        for _ in range(self.W)),
-            n_tail=jnp.zeros((D,), jnp.int32),
+            claims=jnp.full((D, VB), U32MAX),
+            jslot=jnp.full((D, LB), -1, jnp.int32),
+            linv=jnp.ones((D, LB, n_inv), bool),
+            lcon=jnp.ones((D, LB), bool),
             lvl=zeros,
             lpar=jnp.full((D, LB), -1, jnp.int32),
             llane=jnp.full((D, LB), -1, jnp.int32),
@@ -349,6 +358,7 @@ class ShardedEngine(Engine):
             ovf=jnp.zeros((D,), bool),
             fovf=jnp.zeros((D,), bool),
             sovf=jnp.zeros((D,), bool),
+            hovf=jnp.zeros((D,), bool),
             front={k: jnp.zeros_like(v) for k, v in zeros.items()},
             fmask=jnp.zeros((D, LB), bool),
             n_front=jnp.zeros((D,), jnp.int32),
@@ -394,7 +404,8 @@ class ShardedEngine(Engine):
         max_seed = max(len(p) for p in per_dev)
         while self.LB - self.D * self.SC < 2 * max_seed:
             self.LB = self._round_lb(2 * self.LB)
-        self._set_tb()
+        while max_seed + self.LB > self._LOAD_MAX * self.VB:
+            self.VB *= 4
         LB = self.LB
 
         res = CheckResult(distinct_states=0,
@@ -402,6 +413,9 @@ class ShardedEngine(Engine):
         self._states = []
         self._parents = []
         self._lanes = []
+
+        # root invariants/constraints (levels get theirs in the step)
+        inv_r, con_r = (np.asarray(a) for a in self._phase2(rootsb))
 
         carry_np = jax.tree_util.tree_map(
             lambda x: np.array(x), self._fresh_sharded_carry())
@@ -412,14 +426,16 @@ class ShardedEngine(Engine):
                     carry_np["lvl"][k][d, r] = init_arrs[k][i]
                 carry_np["lpar"][d, r] = -1
                 carry_np["llane"][d, r] = -1
+                carry_np["linv"][d, r] = inv_r[i]
+                carry_np["lcon"][d, r] = con_r[i]
             nl[d] = len(per_dev[d])
             rk = root_fp[per_dev[d]]                       # [n, W]
-            order = np.lexsort(tuple(rk[:, w]
-                                     for w in range(W - 1, -1, -1)))
-            for w in range(W):
-                col = np.full((LB,), 0xFFFFFFFF, np.uint32)
-                col[:len(order)] = rk[order, w]
-                carry_np["lvlk"][w][d] = col
+            # host-side probe placement into the empty table shard
+            slots = self._host_probe_assign(rk, vcap=self.VB)
+            for r, sl in enumerate(slots):
+                for w in range(W):
+                    carry_np["vis"][w][d, sl] = rk[r, w]
+                carry_np["jslot"][d, r] = sl
         carry_np["n_lvl"] = nl
         carry = jax.tree_util.tree_map(jnp.asarray, carry_np)
 
@@ -428,20 +444,17 @@ class ShardedEngine(Engine):
         depth = 0
 
         def run_finalize(carry):
-            need = int(n_vis.max()) + self.LB
-            if need > self.VB:
-                while self.VB < need:
-                    self.VB *= 4
-                carry = dict(carry)
-                carry["vis"] = tuple(
-                    jnp.concatenate(
-                        [carry["vis"][w],
-                         jnp.full((D, self.VB -
-                                   carry["vis"][w].shape[1]), U32MAX)],
-                        axis=1)
-                    for w in range(W))
             carry, out = self._fin_jit(carry)
-            return carry, out, np.asarray(out["scal"])     # [D, 9]
+            return carry, out, np.asarray(out["scal"])     # [D, 10]
+
+        def grow_table_if_needed(carry):
+            # pessimistic per-shard load bound, checked between levels
+            need = int(n_vis.max()) + self.LB
+            if need > self._LOAD_MAX * self.VB:
+                while need > self._LOAD_MAX * self.VB:
+                    self.VB *= 4
+                carry = self._rehash_sharded(carry)
+            return carry
 
         def harvest(carry, out, scal):
             nonlocal n_states
@@ -495,16 +508,17 @@ class ShardedEngine(Engine):
         while n_front and depth < max_depth and \
                 res.distinct_states < max_states:
             depth += 1
+            carry = grow_table_if_needed(carry)
             while True:
-                n_chunks = (n_front + self.BL - 1) // self.BL
-                for _ in range(n_chunks):
-                    carry = self._step_jit(carry)
-                carry, out, scal = run_finalize(carry)
+                carry, out = self._level_jit(carry)
+                scal = np.asarray(out["scal"])
                 ovf = bool(scal[:, 4].any())
                 fovf = bool(scal[:, 5].any())
                 sovf = bool(scal[:, 8].any())
-                if not (ovf or fovf or sovf):
+                hovf = bool(scal[:, 9].any())
+                if not (ovf or fovf or sovf or hovf):
                     break
+                old_caps = (self.LB, self.FC, self.SC)
                 if fovf:
                     self.FC *= 4
                 if sovf or fovf:
@@ -514,12 +528,19 @@ class ShardedEngine(Engine):
                     self.LB = self._round_lb(
                         max((4 * self.LB) if ovf else self.LB,
                             4 * self.FC, 2 * self.D * self.SC))
-                self._set_tb()
+                if hovf:
+                    self.VB *= 4
+                    carry = self._rehash_sharded(carry)
                 if verbose:
                     print(f"level {depth}: overflow "
-                          f"(ovf={ovf} fovf={fovf} sovf={sovf}), "
-                          f"LB={self.LB} FC={self.FC} SC={self.SC}")
-                carry = self._grow_sharded(carry)
+                          f"(ovf={ovf} fovf={fovf} sovf={sovf} "
+                          f"hovf={hovf}), LB={self.LB} FC={self.FC} "
+                          f"SC={self.SC} VB={self.VB}")
+                if (self.LB, self.FC, self.SC) != old_caps:
+                    carry = self._grow_sharded(carry)
+                    # the replayed level can add up to the NEW LB keys
+                    # per shard: re-check the table load bound
+                    carry = grow_table_if_needed(carry)
             n_front = harvest(carry, out, scal)
             if int(scal[:, 0].sum()) == 0 and int(scal[:, 6].sum()) == 0:
                 depth -= 1
@@ -537,17 +558,15 @@ class ShardedEngine(Engine):
 
     def _grow_sharded(self, carry):
         """Re-home the carry in bigger per-device buffers (frontier and
-        visited survive; the level buffer resets — the level replays)."""
-        D, W = self.D, self.W
+        the visited table survive; the level buffer resets — the level
+        replays).  Table growth goes through _rehash_sharded first."""
+        D = self.D
         old = carry
+        assert old["vis"][0].shape[1] == self.VB, \
+            "grow the table via _rehash_sharded first"
         new = self._fresh_sharded_carry()
-        ovb = old["vis"][0].shape[1]           # .shape: no transfer
-        new["vis"] = tuple(
-            jnp.concatenate(
-                [old["vis"][w],
-                 jnp.full((D, self.VB - ovb), U32MAX)], axis=1)
-            if self.VB > ovb else old["vis"][w]
-            for w in range(W))
+        new["vis"] = old["vis"]
+        new["claims"] = old["claims"]
         olb = old["fmask"].shape[1]
         pad = self.LB - olb
         new["front"] = {k: jnp.concatenate(
@@ -556,12 +575,39 @@ class ShardedEngine(Engine):
             for k, v in old["front"].items()}
         new["fmask"] = jnp.concatenate(
             [old["fmask"], jnp.zeros((D, pad), bool)], axis=1)
-        new["lvlk"] = tuple(jnp.full((D, self.LB), U32MAX)
-                            for _ in range(W))
         new["n_front"] = old["n_front"]
         new["g_off"] = old["g_off"]
         new["pg_off"] = old["pg_off"]
         return new
+
+    def _rehash_sharded(self, carry):
+        """Per-shard device rehash into self.VB-slot tables (sharded
+        twin of Engine._rehash_tables)."""
+        old_vb = int(carry["vis"][0].shape[1])
+        new_vb = self.VB
+
+        def local(table):
+            t = tuple(x[0] for x in table)
+            allones = jnp.ones((old_vb,), bool)
+            for w in range(self.W):
+                allones &= t[w] == U32MAX
+            new = tuple(jnp.full((new_vb,), U32MAX)
+                        for _ in range(self.W))
+            ncl = jnp.full((new_vb,), U32MAX)
+            ranks = jnp.arange(old_vb, dtype=jnp.uint32)
+            new, ncl, _f, _p, hv = self._probe_insert(
+                new, ncl, t, ~allones, ranks)
+            return (tuple(x[None] for x in new), ncl[None], hv[None])
+
+        fn = _shard_map(
+            local, self.mesh,
+            (tuple(P("d") for _ in range(self.W)),),
+            (tuple(P("d") for _ in range(self.W)), P("d"), P("d")))
+        vis, claims, hv = jax.jit(fn)(carry["vis"])
+        if bool(np.asarray(hv).any()):
+            raise RuntimeError("sharded rehash did not converge — "
+                               "table pathologically full; raise vcap")
+        return dict(carry, vis=vis, claims=claims)
 
     # ------------------------------------------------------------------
     # collective demo kept for the driver dry run
