@@ -143,6 +143,10 @@ class CheckResult:
     seconds: float = 0.0
     overflow_faults: int = 0
     phase_seconds: Dict[str, float] = field(default_factory=dict)
+    # total across the whole mesh — under a multi-controller run the
+    # `violations` list holds only this controller's shards, but this
+    # count (from the replicated scalar matrix) is global
+    violations_global: int = 0
 
     @property
     def states_per_sec(self):
@@ -796,6 +800,7 @@ class Engine:
             res.distinct_states += n_lvl
             res.overflow_faults += faults
             res.generated_states += n_genl
+            res.violations_global += n_viol
             if self.store_states:
                 # after finalize the level's rows live in front (the
                 # buffers swap); they are only overwritten by the
@@ -950,14 +955,19 @@ class Engine:
 
     def _load_checkpoint(self, path):
         import json
-        z = np.load(path, allow_pickle=False)
+        try:
+            z = np.load(path, allow_pickle=False)
+        except (ValueError, OSError) as e:
+            raise CheckpointError(
+                f"{path}: not a readable checkpoint ({e})") from e
         if "meta" not in z:
             raise CheckpointError(f"{path}: not an engine checkpoint "
                                   "(no meta record)")
         meta = json.loads(str(z["meta"]))
         for key in ("cfg", "chunk", "LCAP", "VCAP", "FCAP",
                     "store_states", "n_levels", "distinct", "generated",
-                    "depth", "level_sizes", "faults"):
+                    "depth", "level_sizes", "faults",
+                    "n_states", "n_vis", "n_front"):
             if key not in meta:
                 raise CheckpointError(
                     f"{path}: checkpoint written by an older engine "

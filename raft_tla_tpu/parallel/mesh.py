@@ -99,7 +99,6 @@ class ShardedEngine(Engine):
         # its usable capacity
         self.LB = self._round_lb(max(lcap // self.D, 4 * self.FC,
                                      2 * self.D * self.SC))
-        self._fin_jit = jax.jit(self._sharded_fin_call, donate_argnums=0)
         self._level_jit = jax.jit(self._sharded_level_call,
                                   donate_argnums=0)
 
@@ -108,24 +107,23 @@ class ShardedEngine(Engine):
         return ((int(n) + b - 1) // b) * b
 
     # -----------------------------------------------------------------
-    def _sharded_fin_call(self, carry):
-        specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
-        out_specs = (specs, dict(inv_ok=P("d"), scal=P("d")))
-        return _shard_map(self._shard_finalize, self.mesh,
-                          (specs,), out_specs)(carry)
-
     def _sharded_level_call(self, carry):
         specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
-        out_specs = (specs, dict(inv_ok=P("d"), scal=P("d")))
+        # scal is all-gathered on device and comes back REPLICATED so
+        # every controller process can read the whole [D, 10] matrix
+        # without touching non-addressable shards (multi-host safe)
+        out_specs = (specs, dict(inv_ok=P("d"), scal=P(None)))
         return _shard_map(self._shard_level, self.mesh,
                           (specs,), out_specs)(carry)
 
     def _shard_level(self, carry):
-        """Whole BFS level in one device call (sharded twin of
-        engine/bfs._level_impl): while any device still has frontier
-        rows and no device overflowed, run lock-step chunk steps (the
-        all_to_all inside needs every device participating — drained
-        shards keep stepping with all-invalid rows), then finalize."""
+        """Whole BFS level in one device call: while any device still
+        has frontier rows and no device overflowed, run lock-step chunk
+        steps (the all_to_all inside needs every device participating —
+        drained shards keep stepping with all-invalid rows), then
+        finalize.  The seed level (n_front=0 everywhere) skips straight
+        to the finalize, so this is the ONLY shard_map program the
+        engine compiles."""
         c = jax.tree_util.tree_map(lambda x: x[0], carry)
 
         def cond(c):
@@ -137,7 +135,7 @@ class ShardedEngine(Engine):
         c = lax.while_loop(cond, self._local_step, c)
         new_c, out = self._local_finalize(c)
         return (jax.tree_util.tree_map(lambda x: x[None], new_c),
-                jax.tree_util.tree_map(lambda x: x[None], out))
+                dict(inv_ok=out["inv_ok"][None], scal=out["scal"]))
 
     # -----------------------------------------------------------------
     # per-device chunk step (runs inside _shard_level's while_loop; all
@@ -271,12 +269,6 @@ class ShardedEngine(Engine):
 
     # -----------------------------------------------------------------
 
-    def _shard_finalize(self, carry):
-        c = jax.tree_util.tree_map(lambda x: x[0], carry)
-        new_c, out = self._local_finalize(c)
-        return (jax.tree_util.tree_map(lambda x: x[None], new_c),
-                jax.tree_util.tree_map(lambda x: x[None], out))
-
     def _local_finalize(self, c):
         LB = c["fmask"].shape[0]
         VB = c["vis"][0].shape[0]
@@ -315,11 +307,14 @@ class ShardedEngine(Engine):
 
         front, lvl, fmask, n_front, vis, pg_off, g_next = lax.cond(
             bad, abandon, commit, c)
-        scal = jnp.stack([
+        # [D, 10] replicated via all_gather so every controller process
+        # reads the full matrix (multi-host safe; out_specs P(None))
+        scal = jax.lax.all_gather(jnp.stack([
             n_lvl, n_viol, faults, n_front,
             c["ovf"].astype(jnp.int32), c["fovf"].astype(jnp.int32),
             c["n_gen"], (con & validrow).sum(dtype=jnp.int32),
-            c["sovf"].astype(jnp.int32), c["hovf"].astype(jnp.int32)])
+            c["sovf"].astype(jnp.int32), c["hovf"].astype(jnp.int32)]),
+            "d")
         new_c = dict(c, vis=vis, front=front, lvl=lvl,
                      fmask=fmask, n_front=n_front,
                      n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
@@ -417,8 +412,7 @@ class ShardedEngine(Engine):
         # root invariants/constraints (levels get theirs in the step)
         inv_r, con_r = (np.asarray(a) for a in self._phase2(rootsb))
 
-        carry_np = jax.tree_util.tree_map(
-            lambda x: np.array(x), self._fresh_sharded_carry())
+        carry_np = self._fresh_sharded_carry_host()
         nl = np.zeros((D,), np.int32)
         for d in range(D):
             for r, i in enumerate(per_dev[d]):
@@ -437,14 +431,17 @@ class ShardedEngine(Engine):
                     carry_np["vis"][w][d, sl] = rk[r, w]
                 carry_np["jslot"][d, r] = sl
         carry_np["n_lvl"] = nl
-        carry = jax.tree_util.tree_map(jnp.asarray, carry_np)
+        carry = self._to_device(carry_np)
 
         n_states = 0
         n_vis = np.zeros((D,), np.int64)
         depth = 0
 
         def run_finalize(carry):
-            carry, out = self._fin_jit(carry)
+            # seed carries have n_front=0 everywhere, so the level
+            # program skips straight to its finalize — no separate
+            # finalize-only shard_map compile
+            carry, out = self._level_jit(carry)
             return carry, out, np.asarray(out["scal"])     # [D, 10]
 
         def grow_table_if_needed(carry):
@@ -456,6 +453,17 @@ class ShardedEngine(Engine):
                 carry = self._rehash_sharded(carry)
             return carry
 
+        def local_rows(arr):
+            """[(d, np_row)] for the addressable device rows of a
+            P('d')-sharded [D, ...] array — all rows on one host, only
+            this process's rows under multi-controller."""
+            rows = []
+            for s in arr.addressable_shards:
+                ix = s.index[0]
+                d = (ix.start or 0) if isinstance(ix, slice) else ix
+                rows.append((int(d), np.asarray(s.data)[0]))
+            return sorted(rows, key=lambda t: t[0])
+
         def harvest(carry, out, scal):
             nonlocal n_states
             nl = scal[:, 0]
@@ -463,27 +471,32 @@ class ShardedEngine(Engine):
             res.distinct_states += n_lvl
             res.overflow_faults += int(scal[:, 2].sum())
             res.generated_states += int(scal[:, 6].sum())
+            # global count from the replicated matrix: identical on
+            # every controller (the violations LIST is shard-local)
+            res.violations_global += int(scal[:, 1].sum())
             prefix = np.cumsum(nl) - nl
             if self.store_states:
-                pars = np.asarray(carry["lpar"])
-                lns = np.asarray(carry["llane"])
+                # archives cover this controller's shards (= everything
+                # on one host; MultiHostEngine forbids store_states)
+                pars = local_rows(carry["lpar"])
+                lns = dict(local_rows(carry["llane"]))
                 self._parents.append(np.concatenate(
-                    [pars[d, :nl[d]] for d in range(D)]))
+                    [row[:nl[d]] for d, row in pars]))
                 self._lanes.append(np.concatenate(
-                    [lns[d, :nl[d]] for d in range(D)]))
-                rows = {k: np.asarray(v)
+                    [lns[d][:nl[d]] for d, _ in pars]))
+                rows = {k: dict(local_rows(v))
                         for k, v in carry["front"].items()}
                 self._states.append(
-                    {k: np.concatenate([rows[k][d, :nl[d]]
-                                        for d in range(D)])
+                    {k: np.concatenate([rows[k][d][:nl[d]]
+                                        for d, _ in pars])
                      for k in rows})
             if scal[:, 1].sum():
-                inv_ok = np.asarray(out["inv_ok"])
-                rows = {k: np.asarray(v)
+                inv_shards = local_rows(out["inv_ok"])
+                rows = {k: dict(local_rows(v))
                         for k, v in carry["front"].items()}
-                for d in range(D):
+                for d, inv_ok in inv_shards:
                     for j, nm in enumerate(self.inv_names):
-                        for s in np.nonzero(~inv_ok[d, :nl[d], j])[0]:
+                        for s in np.nonzero(~inv_ok[:nl[d], j])[0]:
                             vsv, vh = decode(lay, _take(
                                 {k: rows[k][d] for k in rows}, s))
                             res.violations.append(Violation(
@@ -501,7 +514,10 @@ class ShardedEngine(Engine):
 
         carry, out, scal = run_finalize(carry)
         n_front = harvest(carry, out, scal)
-        if stop_on_violation and res.violations:
+        # decide from the REPLICATED count: every controller takes the
+        # same branch (a process-local decision would deadlock the
+        # mesh collectives under multi-controller runs)
+        if stop_on_violation and res.violations_global:
             res.seconds = time.time() - t0
             return res
 
@@ -546,7 +562,7 @@ class ShardedEngine(Engine):
                 depth -= 1
             else:
                 res.level_sizes.append(int(scal[:, 7].sum()))
-            if stop_on_violation and res.violations:
+            if stop_on_violation and res.violations_global:
                 break
             if verbose:
                 print(f"depth {depth}: +{int(scal[:, 0].sum())} states "
@@ -555,6 +571,17 @@ class ShardedEngine(Engine):
         res.depth = depth
         res.seconds = time.time() - t0
         return res
+
+    def _to_device(self, carry_np):
+        """Host carry pytree -> device arrays.  MultiHostEngine
+        overrides this to build globally-sharded arrays."""
+        return jax.tree_util.tree_map(jnp.asarray, carry_np)
+
+    def _fresh_sharded_carry_host(self):
+        """Host-side (numpy) fresh carry, for seeding mutation before
+        _to_device."""
+        return jax.tree_util.tree_map(
+            lambda x: np.array(x), self._fresh_sharded_carry())
 
     def _grow_sharded(self, carry):
         """Re-home the carry in bigger per-device buffers (frontier and
@@ -597,12 +624,14 @@ class ShardedEngine(Engine):
             ranks = jnp.arange(old_vb, dtype=jnp.uint32)
             new, ncl, _f, _p, hv = self._probe_insert(
                 new, ncl, t, ~allones, ranks)
-            return (tuple(x[None] for x in new), ncl[None], hv[None])
+            # replicated so every controller can read it (multi-host)
+            hv_all = jax.lax.all_gather(hv, "d").any()
+            return (tuple(x[None] for x in new), ncl[None], hv_all)
 
         fn = _shard_map(
             local, self.mesh,
             (tuple(P("d") for _ in range(self.W)),),
-            (tuple(P("d") for _ in range(self.W)), P("d"), P("d")))
+            (tuple(P("d") for _ in range(self.W)), P("d"), P()))
         vis, claims, hv = jax.jit(fn)(carry["vis"])
         if bool(np.asarray(hv).any()):
             raise RuntimeError("sharded rehash did not converge — "

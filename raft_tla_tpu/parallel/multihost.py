@@ -1,0 +1,73 @@
+"""Multi-host (DCN) scale-out for the sharded BFS (SURVEY §2.14, §7.2
+L7: "then multi-host over DCN").
+
+The reference's engine-level counterpart is TLC's multi-worker BFS run
+as distributed TLC; here the ShardedEngine's hash-ownership mesh simply
+spans every host's chips: one controller process per host calls the
+same jit'd shard_map program (multi-controller SPMD), the all_to_all
+candidate exchange and the replicated per-level scalar matrix ride ICI
+inside a host and DCN across hosts, and each controller only ever
+touches its own addressable shards (mesh.py's `local_rows` /
+replicated-scal design).
+
+Bring-up:
+
+    # on every host (coordinator = host 0), BEFORE any jax use:
+    from raft_tla_tpu.parallel.multihost import init_distributed
+    init_distributed("host0:9911", num_processes=4, process_id=rank)
+    eng = MultiHostEngine(cfg, chunk=1024, lcap=..., vcap=...)
+    res = eng.check()   # counts + violations_global identical on every
+                        # host; res.violations holds only THIS host's
+                        # shard-local decoded violations
+
+Verified in-repo by tests/test_multihost.py: two controller processes
+x two virtual CPU devices each (gloo collectives — the CPU stand-in
+for DCN) land on oracle-identical counts.
+
+Constraints vs the single-host ShardedEngine:
+- `store_states` must be False: the trace archive would be sharded
+  across hosts, and parent ids cross host boundaries.  Run the
+  single-host engine (or the oracle) to reconstruct a witness trace
+  for a violation found at scale.
+- Level/send capacities must be pre-sized (lcap/fcap/scap): their
+  growth rebuilds global arrays mid-run, which needs a resharding step
+  that is not implemented, so an overflow raises instead of silently
+  growing.  The visited table DOES grow across hosts (the rehash is a
+  shard_map program, and every controller takes the same growth
+  decision from the replicated scalar matrix).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+def init_distributed(coordinator_address: str, num_processes: int,
+                     process_id: int,
+                     cpu_devices_per_process: Optional[int] = None):
+    """Initialize the JAX distributed runtime for a multi-controller
+    run.  On CPU (tests / DCN rehearsal) also selects the gloo
+    collectives backend and, when ``cpu_devices_per_process`` is given,
+    requires the caller to have set
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` before the
+    interpreter started (the axon sitecustomize initializes backends
+    too early for an in-process os.environ write to take effect)."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass                    # non-CPU backend: collectives are native
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+
+
+def __getattr__(name):
+    # lazy: importing the engine initializes the XLA backend, which
+    # must happen AFTER jax.distributed.initialize / init_distributed
+    if name == "MultiHostEngine":
+        from .multihost_engine import MultiHostEngine
+        return MultiHostEngine
+    raise AttributeError(name)

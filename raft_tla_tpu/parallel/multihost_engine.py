@@ -1,0 +1,53 @@
+"""MultiHostEngine implementation — import via
+raft_tla_tpu.parallel.multihost (lazily, AFTER init_distributed)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig
+from .mesh import ShardedEngine
+
+
+class MultiHostEngine(ShardedEngine):
+    """ShardedEngine whose mesh spans every process's devices."""
+
+    def __init__(self, cfg: ModelConfig, chunk: int = 512,
+                 store_states: bool = False, **kw):
+        if store_states:
+            raise ValueError(
+                "MultiHostEngine requires store_states=False (the "
+                "trace archive cannot span hosts); reproduce traces "
+                "with the single-host engine")
+        kw.pop("devices", None)
+        super().__init__(cfg, devices=jax.devices(), chunk=chunk,
+                         store_states=False, **kw)
+
+    # -- global-array plumbing -----------------------------------------
+
+    def _to_device(self, carry_np):
+        """Every controller holds the full logical carry in host
+        memory (cheap at checker scale) and serves its local shards."""
+        def leaf(x):
+            x = np.asarray(x)
+            sharding = NamedSharding(self.mesh, P("d"))
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx: x[idx])
+        return jax.tree_util.tree_map(leaf, carry_np)
+
+    def _fresh_sharded_carry_host(self):
+        # the base builder makes process-local arrays — fine as a host
+        # template (np.array on addressable arrays)
+        return jax.tree_util.tree_map(
+            np.array, ShardedEngine._fresh_sharded_carry(self))
+
+    def _fresh_sharded_carry(self):
+        return self._to_device(self._fresh_sharded_carry_host())
+
+    def _grow_sharded(self, carry):
+        raise RuntimeError(
+            "buffer overflow in a multi-host run: pre-size "
+            "lcap/vcap/fcap/scap (mid-run growth would rebuild global "
+            "arrays, which is not supported across controllers)")

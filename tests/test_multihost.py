@@ -1,0 +1,62 @@
+"""Multi-host (multi-controller) BFS: two processes x two virtual CPU
+devices, gloo collectives — the in-repo stand-in for a DCN-spanning
+mesh (SURVEY §2.14 "DCN across hosts").  Both controllers must land on
+the oracle's exact counts, independently.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from raft_tla_tpu.config import NEXT_ASYNC, Bounds, ModelConfig
+from raft_tla_tpu.models.explore import explore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tools", "multihost_worker.py")
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_controllers_match_oracle():
+    want = explore(MICRO)
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(pid), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO) for pid in range(2)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("RESULT ")]
+        assert line, f"no RESULT line:\n{out}\n{err}"
+        outs.append(json.loads(line[-1][len("RESULT "):]))
+    for r in outs:
+        assert r["n_devices"] == 4          # 2 procs x 2 devices
+        assert r["distinct"] == want.distinct_states
+        assert r["depth"] == want.depth
+        assert r["generated"] == want.generated_states
+        assert r["violations"] == 0
+    # both controllers report identical global results
+    assert outs[0] == dict(outs[1], pid=0)

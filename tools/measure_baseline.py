@@ -1,0 +1,134 @@
+"""Measure BASELINE.md configs #1-#5: native CPU checker (the machine-
+measured TLC stand-in) vs the TPU engine, same counting semantics.
+
+Usage:  python tools/measure_baseline.py [config_no ...]
+
+Writes one JSON file per config under baseline_runs/ so the BASELINE.md
+table can be filled incrementally; reruns overwrite.  Budgets keep every
+run minutes-scale: configs whose spaces exceed the budget are recorded
+with exhausted=false and the rate still holds (level-granular budget,
+identical on both engines).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "baseline_runs")
+os.makedirs(OUT, exist_ok=True)
+
+TLC_CFG = "/root/reference/tlc_membership/raft.cfg"
+APA_CFG = "/root/reference/apalache_no_membership/raft.cfg"
+
+
+def build_cfg(n):
+    from raft_tla_tpu.cfg.parser import load_model
+    from raft_tla_tpu.config import NEXT_DYNAMIC, Bounds
+    if n == 1:
+        # Server=3, MaxTerm=2, MaxLogLen=2 (BASELINE.json config #1)
+        return load_model(TLC_CFG, bounds=Bounds.make(
+            max_log_length=2, max_timeouts=1, max_client_requests=3))
+    if n == 2:
+        # headline metric config (bench.py)
+        cfg = load_model(TLC_CFG, bounds=Bounds.make(
+            max_log_length=3, max_timeouts=2, max_client_requests=3))
+        return cfg.with_(invariants=("ElectionSafety",))
+    if n == 3:
+        # membership workload: Server=4 ⊋ InitServer=3, NextDynamic,
+        # + the invariant BASELINE.json names (authored by us — the
+        # reference has no such operator, SURVEY preamble)
+        cfg = load_model(TLC_CFG, bounds=Bounds.make(
+            max_log_length=2, max_timeouts=1, max_client_requests=2,
+            max_membership_changes=1))
+        return cfg.with_(
+            n_servers=4, init_servers=(0, 1, 2),
+            next_family=NEXT_DYNAMIC,
+            invariants=tuple(cfg.invariants) +
+            ("OneAtATimeMembershipChangeOK",))
+    if n == 4:
+        # apalache_no_membership variant, bounded k=10 as BFS depth
+        return load_model(APA_CFG)
+    if n == 5:
+        # Server=5, MaxTerm=4, MaxLogLen=4, scenario property hunt
+        cfg = load_model(TLC_CFG, bounds=Bounds.make(
+            max_log_length=4, max_timeouts=3, max_client_requests=3))
+        return cfg.with_(n_servers=5, init_servers=(0, 1, 2, 3, 4),
+                         invariants=("ConcurrentLeaders",))
+    raise SystemExit(f"unknown config {n}")
+
+
+# budgets keep runs minutes-scale and inside single-chip HBM for the
+# engine's level buffers; equal budgets on both engines keep the
+# differential count check meaningful even when not exhaustive
+BUDGET = {1: 6_000_000, 2: 2_400_000, 3: 1_500_000, 4: 10**9,
+          5: 1_200_000}
+DEPTH = {4: 10}
+ENGINE_KW = {
+    1: dict(chunk=2048, lcap=1 << 19, vcap=1 << 22),
+    2: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24),
+    3: dict(chunk=1024, lcap=1 << 20, vcap=1 << 23),
+    4: dict(chunk=1024, lcap=1 << 17, vcap=1 << 20),
+    5: dict(chunk=512, lcap=1 << 20, vcap=1 << 23),
+}
+
+
+def measure(n):
+    from raft_tla_tpu import native
+    from raft_tla_tpu.engine.bfs import Engine
+    cfg = build_cfg(n)
+    budget = BUDGET[n]
+    depth = DEPTH.get(n, 10**9)
+    out = {"config": n, "budget": budget, "max_depth": depth}
+
+    t0 = time.time()
+    nat = native.check(cfg, threads=os.cpu_count() or 1,
+                       max_states=budget, max_depth=depth)
+    out["native"] = {
+        "distinct": int(nat.distinct_states), "depth": int(nat.depth),
+        "seconds": round(nat.seconds, 2),
+        "states_per_sec": round(nat.states_per_sec, 1),
+        "violations": len(nat.violations),
+        "exhausted": bool(nat.distinct_states < budget),
+    }
+    print(f"config {n} native: {out['native']}", flush=True)
+
+    eng = Engine(cfg, store_states=False, **ENGINE_KW[n])
+    t0 = time.time()
+    eng.check(max_depth=min(2, depth))          # warm the jit caches
+    compile_s = time.time() - t0
+    t0 = time.time()
+    r = eng.check(max_states=budget, max_depth=depth)
+    secs = time.time() - t0
+    out["engine"] = {
+        "distinct": int(r.distinct_states), "depth": int(r.depth),
+        "seconds": round(secs, 2),
+        "states_per_sec": round(r.distinct_states / max(secs, 1e-9), 1),
+        "compile_seconds": round(compile_s, 1),
+        "violations": len(r.violations),
+        "overflow_faults": int(r.overflow_faults),
+        "exhausted": bool(r.distinct_states < budget),
+    }
+    out["counts_match"] = (
+        out["native"]["distinct"] == out["engine"]["distinct"]
+        and out["native"]["depth"] == out["engine"]["depth"])
+    out["speedup"] = round(out["engine"]["states_per_sec"] /
+                           max(out["native"]["states_per_sec"], 1e-9), 2)
+    print(f"config {n} engine: {out['engine']} "
+          f"match={out['counts_match']} speedup={out['speedup']}",
+          flush=True)
+    with open(os.path.join(OUT, f"config{n}.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
+if __name__ == "__main__":
+    which = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5]
+    for n in which:
+        try:
+            measure(n)
+        except Exception as e:                       # keep going
+            print(f"config {n} FAILED: {type(e).__name__}: {e}",
+                  flush=True)

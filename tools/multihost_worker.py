@@ -1,0 +1,44 @@
+"""Worker process for tests/test_multihost.py: one controller in a
+multi-controller CPU run (gloo collectives = the DCN stand-in).
+
+Usage: python tools/multihost_worker.py <pid> <nproc> <port>
+Caller must set XLA_FLAGS=--xla_force_host_platform_device_count=N and
+JAX_PLATFORMS=cpu in the environment BEFORE the interpreter starts.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+from raft_tla_tpu.parallel.multihost import init_distributed  # noqa: E402
+
+init_distributed(f"127.0.0.1:{port}", num_processes=nproc,
+                 process_id=pid)
+
+# AFTER distributed init: importing the engine initializes XLA
+from raft_tla_tpu.parallel.multihost import MultiHostEngine  # noqa: E402
+
+from raft_tla_tpu.config import NEXT_ASYNC, Bounds, ModelConfig  # noqa: E402
+
+cfg = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+D = len(jax.devices())
+eng = MultiHostEngine(cfg, chunk=4 * D, lcap=1 << 12, vcap=1 << 15)
+r = eng.check()
+print("RESULT " + json.dumps(dict(
+    pid=pid, n_devices=D,
+    distinct=int(r.distinct_states), depth=int(r.depth),
+    generated=int(r.generated_states),
+    violations=int(r.violations_global))),
+    flush=True)
