@@ -945,6 +945,7 @@ class Engine:
             generated=res.generated_states,
             faults=res.overflow_faults,
             level_sizes=res.level_sizes,
+            viol_global=res.violations_global,
             n_levels=len(self._parents),
             store_states=self.store_states,
             cfg=repr(self.cfg))))
@@ -964,9 +965,13 @@ class Engine:
             raise CheckpointError(f"{path}: not an engine checkpoint "
                                   "(no meta record)")
         meta = json.loads(str(z["meta"]))
+        if meta.get("sharded"):
+            raise CheckpointError(
+                f"{path}: sharded-engine checkpoint — resume it with "
+                "ShardedEngine on the same mesh size")
         for key in ("cfg", "chunk", "LCAP", "VCAP", "FCAP",
                     "store_states", "n_levels", "distinct", "generated",
-                    "depth", "level_sizes", "faults",
+                    "depth", "level_sizes", "faults", "viol_global",
                     "n_states", "n_vis", "n_front"):
             if key not in meta:
                 raise CheckpointError(
@@ -1020,7 +1025,8 @@ class Engine:
             distinct_states=meta["distinct"],
             generated_states=meta["generated"], depth=meta["depth"],
             level_sizes=list(meta["level_sizes"]),
-            overflow_faults=meta["faults"])
+            overflow_faults=meta["faults"],
+            violations_global=meta["viol_global"])
         for nm, sid in zip(z["viol_names"], z["viol_ids"]):
             res.violations.append(Violation(str(nm), int(sid)))
         return carry, res, meta

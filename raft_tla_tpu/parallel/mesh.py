@@ -63,7 +63,7 @@ except ImportError:                     # pragma: no cover - older jax
 
 from ..config import ModelConfig
 from ..engine.bfs import (CheckResult, Engine, U32MAX, Violation, _cat,
-                          _take)
+                          _leaf_name, _take)
 from ..models.raft import init_state
 from ..ops.codec import C_OVERFLOW, decode, encode
 
@@ -368,74 +368,86 @@ class ShardedEngine(Engine):
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
               verbose: bool = False) -> CheckResult:
-        if checkpoint_path or resume_from:
+        if (checkpoint_path or resume_from) and jax.process_count() > 1:
             raise NotImplementedError(
-                "checkpoint/resume is single-device only for now "
-                "(the sharded carry layout needs its own serializer)")
+                "checkpoint/resume is single-controller only (a "
+                "multi-host checkpoint would need per-controller "
+                "shard files)")
         t0 = time.time()
         lay = self.lay
-        D, W, LB = self.D, self.W, self.LB
-        init_list = (seed_states if seed_states is not None
-                     else [init_state(self.cfg)])
-        init_arrs = _cat([
-            {k: np.asarray(v)[None] for k, v in s.items()}
-            if isinstance(s, dict) else
-            {k: v[None] for k, v in encode(lay, *s).items()}
-            for s in init_list])
-        rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
-        root_fp = np.asarray(self._rootfp_jit(rootsb)).astype(np.uint32)
-        # host-side dedup of seeds + ownership routing
-        keys = [tuple(int(root_fp[i, w]) for w in range(W))
-                for i in range(root_fp.shape[0])]
-        seen = {}
-        for i, k in enumerate(keys):
-            seen.setdefault(k, i)
-        per_dev: List[List[int]] = [[] for _ in range(D)]
-        for k, i in sorted(seen.items(), key=lambda kv: kv[1]):
-            per_dev[int(k[W - 1]) % D].append(i)
-        # grow the level shard until the most-loaded device's seeds fit
-        # with the receive-window margin (punctuated-search seed sets
-        # can be thousands of states, hash-skewed across devices)
-        max_seed = max(len(p) for p in per_dev)
-        while self.LB - self.D * self.SC < 2 * max_seed:
-            self.LB = self._round_lb(2 * self.LB)
-        while max_seed + self.LB > self._LOAD_MAX * self.VB:
-            self.VB *= 4
-        LB = self.LB
+        D, W = self.D, self.W
+        if resume_from is not None:
+            carry, res, meta = self._load_checkpoint(resume_from)
+            n_states = meta["n_states"]
+            n_vis = np.asarray(meta["n_vis"], dtype=np.int64)
+            depth = meta["depth"]
+            n_front = meta["n_front"]
+            resumed = True
+        else:
+            init_list = (seed_states if seed_states is not None
+                         else [init_state(self.cfg)])
+            init_arrs = _cat([
+                {k: np.asarray(v)[None] for k, v in s.items()}
+                if isinstance(s, dict) else
+                {k: v[None] for k, v in encode(lay, *s).items()}
+                for s in init_list])
+            rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
+            root_fp = np.asarray(
+                self._rootfp_jit(rootsb)).astype(np.uint32)
+            # host-side dedup of seeds + ownership routing
+            keys = [tuple(int(root_fp[i, w]) for w in range(W))
+                    for i in range(root_fp.shape[0])]
+            seen = {}
+            for i, k in enumerate(keys):
+                seen.setdefault(k, i)
+            per_dev: List[List[int]] = [[] for _ in range(D)]
+            for k, i in sorted(seen.items(), key=lambda kv: kv[1]):
+                per_dev[int(k[W - 1]) % D].append(i)
+            # grow the level shard until the most-loaded device's seeds
+            # fit with the receive-window margin (punctuated-search
+            # seed sets can be thousands of states, hash-skewed across
+            # devices)
+            max_seed = max(len(p) for p in per_dev)
+            while self.LB - self.D * self.SC < 2 * max_seed:
+                self.LB = self._round_lb(2 * self.LB)
+            while max_seed + self.LB > self._LOAD_MAX * self.VB:
+                self.VB *= 4
 
-        res = CheckResult(distinct_states=0,
-                          generated_states=len(seen), depth=0)
-        self._states = []
-        self._parents = []
-        self._lanes = []
+            res = CheckResult(distinct_states=0,
+                              generated_states=len(seen), depth=0)
+            self._states = []
+            self._parents = []
+            self._lanes = []
 
-        # root invariants/constraints (levels get theirs in the step)
-        inv_r, con_r = (np.asarray(a) for a in self._phase2(rootsb))
+            # root invariants/constraints (levels get theirs in the
+            # step)
+            inv_r, con_r = (np.asarray(a) for a in self._phase2(rootsb))
 
-        carry_np = self._fresh_sharded_carry_host()
-        nl = np.zeros((D,), np.int32)
-        for d in range(D):
-            for r, i in enumerate(per_dev[d]):
-                for k in init_arrs:
-                    carry_np["lvl"][k][d, r] = init_arrs[k][i]
-                carry_np["lpar"][d, r] = -1
-                carry_np["llane"][d, r] = -1
-                carry_np["linv"][d, r] = inv_r[i]
-                carry_np["lcon"][d, r] = con_r[i]
-            nl[d] = len(per_dev[d])
-            rk = root_fp[per_dev[d]]                       # [n, W]
-            # host-side probe placement into the empty table shard
-            slots = self._host_probe_assign(rk, vcap=self.VB)
-            for r, sl in enumerate(slots):
-                for w in range(W):
-                    carry_np["vis"][w][d, sl] = rk[r, w]
-                carry_np["jslot"][d, r] = sl
-        carry_np["n_lvl"] = nl
-        carry = self._to_device(carry_np)
+            carry_np = self._fresh_sharded_carry_host()
+            nl = np.zeros((D,), np.int32)
+            for d in range(D):
+                for r, i in enumerate(per_dev[d]):
+                    for k in init_arrs:
+                        carry_np["lvl"][k][d, r] = init_arrs[k][i]
+                    carry_np["lpar"][d, r] = -1
+                    carry_np["llane"][d, r] = -1
+                    carry_np["linv"][d, r] = inv_r[i]
+                    carry_np["lcon"][d, r] = con_r[i]
+                nl[d] = len(per_dev[d])
+                rk = root_fp[per_dev[d]]                   # [n, W]
+                # host-side probe placement into the empty table shard
+                slots = self._host_probe_assign(rk, vcap=self.VB)
+                for r, sl in enumerate(slots):
+                    for w in range(W):
+                        carry_np["vis"][w][d, sl] = rk[r, w]
+                    carry_np["jslot"][d, r] = sl
+            carry_np["n_lvl"] = nl
+            carry = self._to_device(carry_np)
 
-        n_states = 0
-        n_vis = np.zeros((D,), np.int64)
-        depth = 0
+            n_states = 0
+            n_vis = np.zeros((D,), np.int64)
+            depth = 0
+            resumed = False
 
         def run_finalize(carry):
             # seed carries have n_front=0 everywhere, so the level
@@ -475,6 +487,12 @@ class ShardedEngine(Engine):
             # every controller (the violations LIST is shard-local)
             res.violations_global += int(scal[:, 1].sum())
             prefix = np.cumsum(nl) - nl
+            rows = None
+            if self.store_states or scal[:, 1].sum():
+                # one device->host transfer of the front buffer, shared
+                # by the state archive and violation decoding
+                rows = {k: dict(local_rows(v))
+                        for k, v in carry["front"].items()}
             if self.store_states:
                 # archives cover this controller's shards (= everything
                 # on one host; MultiHostEngine forbids store_states)
@@ -484,16 +502,12 @@ class ShardedEngine(Engine):
                     [row[:nl[d]] for d, row in pars]))
                 self._lanes.append(np.concatenate(
                     [lns[d][:nl[d]] for d, _ in pars]))
-                rows = {k: dict(local_rows(v))
-                        for k, v in carry["front"].items()}
                 self._states.append(
                     {k: np.concatenate([rows[k][d][:nl[d]]
                                         for d, _ in pars])
                      for k in rows})
             if scal[:, 1].sum():
                 inv_shards = local_rows(out["inv_ok"])
-                rows = {k: dict(local_rows(v))
-                        for k, v in carry["front"].items()}
                 for d, inv_ok in inv_shards:
                     for j, nm in enumerate(self.inv_names):
                         for s in np.nonzero(~inv_ok[:nl[d], j])[0]:
@@ -512,8 +526,9 @@ class ShardedEngine(Engine):
                     "the engine's int32 global-id width")
             return int(scal[:, 3].max())
 
-        carry, out, scal = run_finalize(carry)
-        n_front = harvest(carry, out, scal)
+        if not resumed:
+            carry, out, scal = run_finalize(carry)
+            n_front = harvest(carry, out, scal)
         # decide from the REPLICATED count: every controller takes the
         # same branch (a process-local decision would deadlock the
         # mesh collectives under multi-controller runs)
@@ -562,6 +577,10 @@ class ShardedEngine(Engine):
                 depth -= 1
             else:
                 res.level_sizes.append(int(scal[:, 7].sum()))
+            if checkpoint_path is not None and \
+                    depth % max(1, checkpoint_every) == 0:
+                self._save_checkpoint(checkpoint_path, carry, res,
+                                      depth, n_states, n_vis, n_front)
             if stop_on_violation and res.violations_global:
                 break
             if verbose:
@@ -606,6 +625,130 @@ class ShardedEngine(Engine):
         new["g_off"] = old["g_off"]
         new["pg_off"] = old["pg_off"]
         return new
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (sharded layout; single-controller only — the
+    # check() entry guards multi-host).  Same wavefront semantics as
+    # engine/bfs: written at level boundaries, resume lands on
+    # bit-identical counts.
+    # ------------------------------------------------------------------
+
+    def _save_checkpoint(self, path, carry, res, depth, n_states,
+                         n_vis, n_front):
+        import json
+        import os
+        data = {}
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(carry)[0]:
+            data[_leaf_name(kp)] = np.asarray(leaf)
+        if self.store_states:
+            for i, arr in enumerate(self._parents):
+                data[f"parents|{i}"] = arr
+            for i, arr in enumerate(self._lanes):
+                data[f"lanes|{i}"] = arr
+            for i, blk in enumerate(self._states):
+                for k, v in blk.items():
+                    data[f"states|{i}|{k}"] = v
+        data["viol_names"] = np.array(
+            [v.invariant for v in res.violations])
+        data["viol_ids"] = np.array(
+            [v.state_id for v in res.violations], dtype=np.int64)
+        data["meta"] = np.array(json.dumps(dict(
+            sharded=True, D=self.D, chunk=self.chunk,
+            LB=self.LB, VB=self.VB, FC=self.FC, SC=self.SC,
+            LCAP=self.LCAP, VCAP=self.VCAP, FCAP=self.FCAP,
+            depth=depth, n_states=n_states,
+            n_vis=[int(x) for x in n_vis], n_front=int(n_front),
+            distinct=res.distinct_states,
+            generated=res.generated_states,
+            faults=res.overflow_faults,
+            level_sizes=res.level_sizes,
+            viol_global=res.violations_global,
+            n_levels=len(self._parents),
+            store_states=self.store_states,
+            cfg=repr(self.cfg))))
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, **data)
+        os.replace(tmp, path)
+
+    def _load_checkpoint(self, path):
+        import json
+        from ..engine.bfs import CheckpointError
+        try:
+            z = np.load(path, allow_pickle=False)
+        except (ValueError, OSError) as e:
+            raise CheckpointError(
+                f"{path}: not a readable checkpoint ({e})") from e
+        if "meta" not in z:
+            raise CheckpointError(f"{path}: not an engine checkpoint "
+                                  "(no meta record)")
+        meta = json.loads(str(z["meta"]))
+        if not meta.get("sharded"):
+            raise CheckpointError(
+                f"{path}: single-device checkpoint — resume it with "
+                "the single-device Engine")
+        for key in ("D", "chunk", "LB", "VB", "FC", "SC", "depth",
+                    "n_states", "n_vis", "n_front", "distinct",
+                    "generated", "faults", "level_sizes", "viol_global",
+                    "n_levels", "store_states", "cfg"):
+            if key not in meta:
+                raise CheckpointError(
+                    f"{path}: checkpoint written by an older engine "
+                    f"version (meta lacks {key!r}) — re-run without "
+                    "--resume")
+        if meta["cfg"] != repr(self.cfg):
+            raise CheckpointError(
+                "checkpoint was written for a different model config:\n"
+                f"  checkpoint: {meta['cfg']}\n"
+                f"  engine:     {self.cfg!r}")
+        if meta["D"] != self.D:
+            raise CheckpointError(
+                f"checkpoint was written on a {meta['D']}-device mesh; "
+                f"this engine has {self.D} devices (shard ownership is "
+                "mesh-size dependent)")
+        if meta["chunk"] != self.chunk:
+            raise CheckpointError(
+                f"checkpoint was written with chunk={meta['chunk']}; "
+                f"resume with the same chunk (engine has {self.chunk})")
+        self.LB, self.VB, self.FC, self.SC = (
+            meta["LB"], meta["VB"], meta["FC"], meta["SC"])
+        template = jax.eval_shape(lambda: self._fresh_sharded_carry())
+        leaves, _ = jax.tree_util.tree_flatten_with_path(template)
+        missing = [_leaf_name(kp) for kp, _ in leaves
+                   if _leaf_name(kp) not in z]
+        if missing:
+            raise CheckpointError(
+                f"{path}: checkpoint carry layout is from an "
+                f"incompatible engine version (missing {missing[:3]}"
+                f"{'…' if len(missing) > 3 else ''}) — re-run without "
+                "--resume")
+        host = {(_leaf_name(kp)): z[_leaf_name(kp)] for kp, _ in leaves}
+        carry = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(template),
+            [self._to_device(host[_leaf_name(kp)])
+             for kp, _ in leaves])
+        if self.store_states and not meta["store_states"]:
+            raise CheckpointError(
+                "checkpoint was written with store_states=False; "
+                "resume with store_states=False")
+        self._parents, self._lanes, self._states = [], [], []
+        if self.store_states and meta["store_states"]:
+            self._parents = [z[f"parents|{i}"]
+                             for i in range(meta["n_levels"])]
+            self._lanes = [z[f"lanes|{i}"]
+                           for i in range(meta["n_levels"])]
+            keys = list(template["lvl"].keys())
+            self._states = [
+                {k: z[f"states|{i}|{k}"] for k in keys}
+                for i in range(meta["n_levels"])]
+        res = CheckResult(
+            distinct_states=meta["distinct"],
+            generated_states=meta["generated"], depth=meta["depth"],
+            level_sizes=list(meta["level_sizes"]),
+            overflow_faults=meta["faults"],
+            violations_global=meta["viol_global"])
+        for nm, sid in zip(z["viol_names"], z["viol_ids"]):
+            res.violations.append(Violation(str(nm), int(sid)))
+        return carry, res, meta
 
     def _rehash_sharded(self, carry):
         """Per-shard device rehash into self.VB-slot tables (sharded

@@ -39,17 +39,15 @@ Constraints vs the single-host ShardedEngine:
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 
 def init_distributed(coordinator_address: str, num_processes: int,
-                     process_id: int,
-                     cpu_devices_per_process: Optional[int] = None):
+                     process_id: int):
     """Initialize the JAX distributed runtime for a multi-controller
     run.  On CPU (tests / DCN rehearsal) also selects the gloo
-    collectives backend and, when ``cpu_devices_per_process`` is given,
-    requires the caller to have set
+    collectives backend; for multiple virtual CPU devices per process
+    the caller must have set
     ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` before the
     interpreter started (the axon sitecustomize initializes backends
     too early for an in-process os.environ write to take effect)."""

@@ -25,6 +25,14 @@ class MultiHostEngine(ShardedEngine):
         super().__init__(cfg, devices=jax.devices(), chunk=chunk,
                          store_states=False, **kw)
 
+    def check(self, *args, **kw):
+        if kw.get("checkpoint_path") or kw.get("resume_from"):
+            raise NotImplementedError(
+                "checkpoint/resume is not supported by MultiHostEngine "
+                "(a multi-host checkpoint would need per-controller "
+                "shard files); use ShardedEngine on one controller")
+        return super().check(*args, **kw)
+
     # -- global-array plumbing -----------------------------------------
 
     def _to_device(self, carry_np):

@@ -38,6 +38,36 @@ def test_checkpoint_resume_identical(tmp_path):
     assert sum(len(p) for p in e2._parents) == full.distinct_states
 
 
+def test_sharded_checkpoint_resume_identical(tmp_path):
+    import jax
+
+    from raft_tla_tpu.parallel.mesh import ShardedEngine
+    devs = jax.devices()
+    full = ShardedEngine(MICRO, devices=devs, chunk=8 * len(devs),
+                         store_states=True).check()
+
+    ckpt = str(tmp_path / "sharded.ckpt")
+    e1 = ShardedEngine(MICRO, devices=devs, chunk=8 * len(devs),
+                       store_states=True)
+    part = e1.check(max_depth=12, checkpoint_path=ckpt)
+    assert part.depth == 12
+    assert part.distinct_states < full.distinct_states
+
+    e2 = ShardedEngine(MICRO, devices=devs, chunk=8 * len(devs),
+                       store_states=True)
+    resumed = e2.check(resume_from=ckpt)
+    assert resumed.distinct_states == full.distinct_states
+    assert resumed.depth == full.depth
+    assert resumed.generated_states == full.generated_states
+    assert resumed.level_sizes == full.level_sizes
+    assert sum(len(p) for p in e2._parents) == full.distinct_states
+
+    # cross-engine resumes are rejected with a clear error
+    from raft_tla_tpu.engine.bfs import CheckpointError
+    with pytest.raises(CheckpointError, match="sharded-engine"):
+        Engine(MICRO, chunk=64).check(resume_from=ckpt)
+
+
 def test_checkpoint_config_mismatch(tmp_path):
     ckpt = str(tmp_path / "run.ckpt")
     Engine(MICRO, chunk=64, store_states=False).check(
