@@ -110,6 +110,10 @@ def main():
             "correctness_gate": bool(gate_ok),
             "counts_match_native": bool(count_ok),
             "exhausted": bool(r.distinct_states < budget),
+            # the dedup-exhaustiveness claim's collision bound
+            # (64-bit fingerprints; ADVICE r1, SURVEY §7.4 pt 4)
+            "expected_fp_collisions": float(
+                r.distinct_states ** 2 / 2.0 ** 65),
         },
     }
     print(json.dumps(out))

@@ -213,14 +213,23 @@ def cmd_check(args):
             print(f"FAULT: {r.overflow_faults} un-representable states "
                   f"(bounds too small for the disabled-constraint space)",
                   file=sys.stderr)
-    print(json.dumps({
+    out = {
         "distinct_states": int(distinct),
         "generated_states": int(gen),
         "depth": int(depth),
         "seconds": round(secs, 3),
         "states_per_sec": round(distinct / max(secs, 1e-9), 1),
         "violations": len(viol),
-    }))
+    }
+    if args.engine != "oracle":
+        # dedup is fingerprint-based (TLC semantics): surface the
+        # expected-collision bound the exhaustiveness claim rests on
+        # (ADVICE r1; SURVEY §7.4 pt 4).  E[collisions] <= n^2 / 2^(b+1)
+        bits = 128 if args.fp128 else 64
+        out["fp_bits"] = bits
+        out["expected_fp_collisions"] = float(
+            distinct * distinct / 2.0 ** (bits + 1))
+    print(json.dumps(out))
     for k, (name, trace) in enumerate(viol):
         if args.engine == "oracle":
             print(f"\nViolation {k}: {name}")

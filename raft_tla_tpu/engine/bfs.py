@@ -164,6 +164,139 @@ def _leaf_name(key_path) -> str:
         str(getattr(p, "key", getattr(p, "idx", p))) for p in key_path)
 
 
+# ---------------------------------------------------------------------------
+# checkpoint serializer, shared by Engine and ShardedEngine (TLC
+# checkpoints to states/ — /root/reference/.gitignore:4; SURVEY §5).
+# A checkpoint is the full BFS wavefront: {carry pytree leaves (by
+# _leaf_name), level counters, result-so-far, and (when store_states)
+# the parent/lane/state archives for trace reconstruction}.  Written at
+# level boundaries, so a resumed run replays nothing and lands on
+# bit-identical counts.  Engine-specific capacity fields ride in the
+# meta dict the callers supply.
+# ---------------------------------------------------------------------------
+
+_CKPT_BASE_KEYS = ("cfg", "chunk", "store_states", "n_levels",
+                   "distinct", "generated", "depth", "level_sizes",
+                   "faults", "viol_global", "n_states", "n_vis",
+                   "n_front")
+
+
+def ckpt_write(path, carry, store_states, parents, lanes, states, res,
+               meta):
+    import json
+    import os
+    data = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(carry)[0]:
+        data[_leaf_name(kp)] = np.asarray(leaf)
+    if store_states:
+        for i, arr in enumerate(parents):
+            data[f"parents|{i}"] = arr
+        for i, arr in enumerate(lanes):
+            data[f"lanes|{i}"] = arr
+        for i, blk in enumerate(states):
+            for k, v in blk.items():
+                data[f"states|{i}|{k}"] = v
+    data["viol_names"] = np.array([v.invariant for v in res.violations])
+    data["viol_ids"] = np.array([v.state_id for v in res.violations],
+                                dtype=np.int64)
+    base = dict(distinct=res.distinct_states,
+                generated=res.generated_states,
+                faults=res.overflow_faults,
+                level_sizes=res.level_sizes,
+                viol_global=res.violations_global,
+                n_levels=len(parents), store_states=store_states)
+    data["meta"] = np.array(json.dumps({**base, **meta}))
+    tmp = path + ".tmp.npz"           # .npz suffix: savez won't append
+    np.savez(tmp, **data)
+    os.replace(tmp, path)
+
+
+def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded):
+    """np.load + the meta validation both engines share.  Returns
+    (npz, meta) or raises CheckpointError."""
+    import json
+    try:
+        z = np.load(path, allow_pickle=False)
+    except (ValueError, OSError) as e:
+        raise CheckpointError(
+            f"{path}: not a readable checkpoint ({e})") from e
+    if "meta" not in z:
+        raise CheckpointError(f"{path}: not an engine checkpoint "
+                              "(no meta record)")
+    meta = json.loads(str(z["meta"]))
+    if bool(meta.get("sharded")) != sharded:
+        raise CheckpointError(
+            f"{path}: sharded-engine checkpoint — resume it with "
+            "ShardedEngine on the same mesh size" if meta.get("sharded")
+            else f"{path}: single-device checkpoint — resume it with "
+            "the single-device Engine")
+    for key in _CKPT_BASE_KEYS + tuple(extra_keys):
+        if key not in meta:
+            raise CheckpointError(
+                f"{path}: checkpoint written by an older engine "
+                f"version (meta lacks {key!r}) — re-run without "
+                "--resume")
+    if meta["cfg"] != cfg_repr:
+        raise CheckpointError(
+            "checkpoint was written for a different model config:\n"
+            f"  checkpoint: {meta['cfg']}\n"
+            f"  engine:     {cfg_repr}")
+    if meta["chunk"] != chunk:
+        raise CheckpointError(
+            f"checkpoint was written with chunk={meta['chunk']}; "
+            f"resume with the same chunk (engine has {chunk} — "
+            "capacities are rounded to the chunk size)")
+    return z, meta
+
+
+def ckpt_carry(path, z, template, to_device):
+    """Rebuild the carry pytree from archived leaves; `to_device` is
+    jnp.asarray for single-controller engines, the global-array builder
+    for multi-controller ones."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(template)
+    missing = [_leaf_name(kp) for kp, _ in leaves
+               if _leaf_name(kp) not in z]
+    if missing:
+        raise CheckpointError(
+            f"{path}: checkpoint carry layout is from an "
+            f"incompatible engine version (missing {missing[:3]}"
+            f"{'…' if len(missing) > 3 else ''}) — re-run without "
+            "--resume")
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template),
+        [to_device(z[_leaf_name(kp)]) for kp, _ in leaves])
+
+
+def ckpt_archives(z, meta, template, store_states):
+    """(parents, lanes, states) trace archives; empty when the store is
+    off."""
+    if store_states and not meta["store_states"]:
+        raise CheckpointError(
+            "checkpoint was written with store_states=False; "
+            "resume with store_states=False (CLI: --no-store) — "
+            "trace archives cannot be reconstructed")
+    if not (store_states and meta["store_states"]):
+        return [], [], []
+    parents = [z[f"parents|{i}"] for i in range(meta["n_levels"])]
+    lanes = [z[f"lanes|{i}"] for i in range(meta["n_levels"])]
+    keys = list(template["lvl"].keys())
+    states = [{k: z[f"states|{i}|{k}"] for k in keys}
+              for i in range(meta["n_levels"])]
+    return parents, lanes, states
+
+
+def ckpt_result(z, meta) -> "CheckResult":
+    res = CheckResult(
+        distinct_states=meta["distinct"],
+        generated_states=meta["generated"], depth=meta["depth"],
+        level_sizes=list(meta["level_sizes"]),
+        overflow_faults=meta["faults"],
+        violations_global=meta["viol_global"])
+    for nm, sid in zip(z["viol_names"], z["viol_ids"]):
+        res.violations.append(Violation(str(nm), int(sid)))
+    return res
+
+
 class Engine:
     """One compiled checker instance per (ModelConfig, chunk size).
 
@@ -210,8 +343,7 @@ class Engine:
         self._phase2 = jax.jit(self._phase2_impl)
         self._step_jit = jax.jit(self._chunk_step_impl, donate_argnums=0)
         self._fin_jit = jax.jit(self._finalize_impl, donate_argnums=0)
-        self._rootfp_jit = jax.jit(
-            lambda svb: jax.vmap(self.fpr.fingerprint)(svb))
+        self._rootfp_jit = jax.jit(self.fpr.fingerprint_batch)
 
     def _round_cap(self, n: int) -> int:
         c = self.chunk
@@ -493,7 +625,7 @@ class Engine:
 
         # fingerprint only the compacted candidates
         fp = lax.optimization_barrier(
-            jax.vmap(self.fpr.fingerprint)(cand_c))      # [FCAP, W]
+            self.fpr.fingerprint_batch(cand_c))          # [FCAP, W]
         keys = tuple(jnp.where(elive, fp[:, w], U32MAX)
                      for w in range(W))
         # any overflow means this level replays — stop inserting so the
@@ -910,84 +1042,21 @@ class Engine:
         return res
 
     # ------------------------------------------------------------------
-    # checkpoint / resume (TLC checkpoints to states/ —
-    # /root/reference/.gitignore:4; SURVEY §5).  A checkpoint is the
-    # full BFS wavefront: {carry pytree, level counters, result-so-far,
-    # and (when store_states) the parent/lane/state archives needed for
-    # trace reconstruction}.  Written at level boundaries, so a resumed
-    # run replays nothing and lands on bit-identical counts.
+    # checkpoint / resume (see the module-level ckpt_* serializer)
     # ------------------------------------------------------------------
 
     def _save_checkpoint(self, path, carry, res, depth, n_states,
                          n_vis, n_front):
-        import json
-        data = {}
-        leaves = jax.tree_util.tree_flatten_with_path(carry)[0]
-        for kp, leaf in leaves:
-            data[_leaf_name(kp)] = np.asarray(leaf)
-        if self.store_states:
-            for i, arr in enumerate(self._parents):
-                data[f"parents|{i}"] = arr
-            for i, arr in enumerate(self._lanes):
-                data[f"lanes|{i}"] = arr
-            for i, blk in enumerate(self._states):
-                for k, v in blk.items():
-                    data[f"states|{i}|{k}"] = v
-        data["viol_names"] = np.array(
-            [v.invariant for v in res.violations])
-        data["viol_ids"] = np.array(
-            [v.state_id for v in res.violations], dtype=np.int64)
-        data["meta"] = np.array(json.dumps(dict(
-            depth=depth, n_states=n_states, n_vis=n_vis,
-            n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
-            FCAP=self.FCAP, chunk=self.chunk,
-            distinct=res.distinct_states,
-            generated=res.generated_states,
-            faults=res.overflow_faults,
-            level_sizes=res.level_sizes,
-            viol_global=res.violations_global,
-            n_levels=len(self._parents),
-            store_states=self.store_states,
-            cfg=repr(self.cfg))))
-        import os
-        tmp = path + ".tmp.npz"       # .npz suffix: savez won't append
-        np.savez(tmp, **data)
-        os.replace(tmp, path)
+        ckpt_write(path, carry, self.store_states, self._parents,
+                   self._lanes, self._states, res, dict(
+                       depth=depth, n_states=n_states, n_vis=n_vis,
+                       n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
+                       FCAP=self.FCAP, chunk=self.chunk,
+                       cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
-        import json
-        try:
-            z = np.load(path, allow_pickle=False)
-        except (ValueError, OSError) as e:
-            raise CheckpointError(
-                f"{path}: not a readable checkpoint ({e})") from e
-        if "meta" not in z:
-            raise CheckpointError(f"{path}: not an engine checkpoint "
-                                  "(no meta record)")
-        meta = json.loads(str(z["meta"]))
-        if meta.get("sharded"):
-            raise CheckpointError(
-                f"{path}: sharded-engine checkpoint — resume it with "
-                "ShardedEngine on the same mesh size")
-        for key in ("cfg", "chunk", "LCAP", "VCAP", "FCAP",
-                    "store_states", "n_levels", "distinct", "generated",
-                    "depth", "level_sizes", "faults", "viol_global",
-                    "n_states", "n_vis", "n_front"):
-            if key not in meta:
-                raise CheckpointError(
-                    f"{path}: checkpoint written by an older engine "
-                    f"version (meta lacks {key!r}) — re-run without "
-                    "--resume")
-        if meta["cfg"] != repr(self.cfg):
-            raise CheckpointError(
-                "checkpoint was written for a different model config:\n"
-                f"  checkpoint: {meta['cfg']}\n"
-                f"  engine:     {self.cfg!r}")
-        if meta["chunk"] != self.chunk:
-            raise CheckpointError(
-                f"checkpoint was written with chunk={meta['chunk']}; "
-                f"resume with the same chunk (engine has {self.chunk} — "
-                "capacities are rounded to the chunk size)")
+        z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
+                            ("LCAP", "VCAP", "FCAP"), sharded=False)
         self.LCAP, self.VCAP, self.FCAP = (meta["LCAP"], meta["VCAP"],
                                            meta["FCAP"])
         # eval_shape: the template is only read for structure/key paths,
@@ -995,41 +1064,10 @@ class Engine:
         # double device memory at resume)
         template = jax.eval_shape(
             lambda: self._fresh_carry(self.LCAP, self.VCAP, self.FCAP))
-        leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
-        missing = [_leaf_name(kp) for kp, _ in leaves
-                   if _leaf_name(kp) not in z]
-        if missing:
-            raise CheckpointError(
-                f"{path}: checkpoint carry layout is from an "
-                f"incompatible engine version (missing {missing[:3]}"
-                f"{'…' if len(missing) > 3 else ''}) — re-run without "
-                "--resume")
-        vals = [jnp.asarray(z[_leaf_name(kp)]) for kp, _ in leaves]
-        carry = jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(template), vals)
-        if self.store_states and not meta["store_states"]:
-            raise CheckpointError(
-                "checkpoint was written with store_states=False; "
-                "resume with store_states=False (CLI: --no-store) — "
-                "trace archives cannot be reconstructed")
-        if self.store_states and meta["store_states"]:
-            self._parents = [z[f"parents|{i}"]
-                             for i in range(meta["n_levels"])]
-            self._lanes = [z[f"lanes|{i}"]
-                           for i in range(meta["n_levels"])]
-            keys = list(template["lvl"].keys())
-            self._states = [
-                {k: z[f"states|{i}|{k}"] for k in keys}
-                for i in range(meta["n_levels"])]
-        res = CheckResult(
-            distinct_states=meta["distinct"],
-            generated_states=meta["generated"], depth=meta["depth"],
-            level_sizes=list(meta["level_sizes"]),
-            overflow_faults=meta["faults"],
-            violations_global=meta["viol_global"])
-        for nm, sid in zip(z["viol_names"], z["viol_ids"]):
-            res.violations.append(Violation(str(nm), int(sid)))
-        return carry, res, meta
+        carry = ckpt_carry(path, z, template, jnp.asarray)
+        self._parents, self._lanes, self._states = ckpt_archives(
+            z, meta, template, self.store_states)
+        return carry, ckpt_result(z, meta), meta
 
     # ------------------------------------------------------------------
 

@@ -63,7 +63,8 @@ except ImportError:                     # pragma: no cover - older jax
 
 from ..config import ModelConfig
 from ..engine.bfs import (CheckResult, Engine, U32MAX, Violation, _cat,
-                          _leaf_name, _take)
+                          _take, ckpt_archives, ckpt_carry, ckpt_read,
+                          ckpt_result, ckpt_write)
 from ..models.raft import init_state
 from ..ops.codec import C_OVERFLOW, decode, encode
 
@@ -178,7 +179,7 @@ class ShardedEngine(Engine):
             {k: v.reshape((N,) + v.shape[2:])[take]
              for k, v in cand.items()})
         fp = lax.optimization_barrier(
-            jax.vmap(self.fpr.fingerprint)(cand_c))        # [FC, W]
+            self.fpr.fingerprint_batch(cand_c))            # [FC, W]
         pgid = c["pg_off"] + base + take // A
         lane = take % A
 
@@ -635,120 +636,30 @@ class ShardedEngine(Engine):
 
     def _save_checkpoint(self, path, carry, res, depth, n_states,
                          n_vis, n_front):
-        import json
-        import os
-        data = {}
-        for kp, leaf in jax.tree_util.tree_flatten_with_path(carry)[0]:
-            data[_leaf_name(kp)] = np.asarray(leaf)
-        if self.store_states:
-            for i, arr in enumerate(self._parents):
-                data[f"parents|{i}"] = arr
-            for i, arr in enumerate(self._lanes):
-                data[f"lanes|{i}"] = arr
-            for i, blk in enumerate(self._states):
-                for k, v in blk.items():
-                    data[f"states|{i}|{k}"] = v
-        data["viol_names"] = np.array(
-            [v.invariant for v in res.violations])
-        data["viol_ids"] = np.array(
-            [v.state_id for v in res.violations], dtype=np.int64)
-        data["meta"] = np.array(json.dumps(dict(
-            sharded=True, D=self.D, chunk=self.chunk,
-            LB=self.LB, VB=self.VB, FC=self.FC, SC=self.SC,
-            LCAP=self.LCAP, VCAP=self.VCAP, FCAP=self.FCAP,
-            depth=depth, n_states=n_states,
-            n_vis=[int(x) for x in n_vis], n_front=int(n_front),
-            distinct=res.distinct_states,
-            generated=res.generated_states,
-            faults=res.overflow_faults,
-            level_sizes=res.level_sizes,
-            viol_global=res.violations_global,
-            n_levels=len(self._parents),
-            store_states=self.store_states,
-            cfg=repr(self.cfg))))
-        tmp = path + ".tmp.npz"
-        np.savez(tmp, **data)
-        os.replace(tmp, path)
+        ckpt_write(path, carry, self.store_states, self._parents,
+                   self._lanes, self._states, res, dict(
+                       sharded=True, D=self.D, chunk=self.chunk,
+                       LB=self.LB, VB=self.VB, FC=self.FC, SC=self.SC,
+                       depth=depth, n_states=n_states,
+                       n_vis=[int(x) for x in n_vis],
+                       n_front=int(n_front), cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
-        import json
         from ..engine.bfs import CheckpointError
-        try:
-            z = np.load(path, allow_pickle=False)
-        except (ValueError, OSError) as e:
-            raise CheckpointError(
-                f"{path}: not a readable checkpoint ({e})") from e
-        if "meta" not in z:
-            raise CheckpointError(f"{path}: not an engine checkpoint "
-                                  "(no meta record)")
-        meta = json.loads(str(z["meta"]))
-        if not meta.get("sharded"):
-            raise CheckpointError(
-                f"{path}: single-device checkpoint — resume it with "
-                "the single-device Engine")
-        for key in ("D", "chunk", "LB", "VB", "FC", "SC", "depth",
-                    "n_states", "n_vis", "n_front", "distinct",
-                    "generated", "faults", "level_sizes", "viol_global",
-                    "n_levels", "store_states", "cfg"):
-            if key not in meta:
-                raise CheckpointError(
-                    f"{path}: checkpoint written by an older engine "
-                    f"version (meta lacks {key!r}) — re-run without "
-                    "--resume")
-        if meta["cfg"] != repr(self.cfg):
-            raise CheckpointError(
-                "checkpoint was written for a different model config:\n"
-                f"  checkpoint: {meta['cfg']}\n"
-                f"  engine:     {self.cfg!r}")
+        z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
+                            ("D", "LB", "VB", "FC", "SC"), sharded=True)
         if meta["D"] != self.D:
             raise CheckpointError(
                 f"checkpoint was written on a {meta['D']}-device mesh; "
                 f"this engine has {self.D} devices (shard ownership is "
                 "mesh-size dependent)")
-        if meta["chunk"] != self.chunk:
-            raise CheckpointError(
-                f"checkpoint was written with chunk={meta['chunk']}; "
-                f"resume with the same chunk (engine has {self.chunk})")
         self.LB, self.VB, self.FC, self.SC = (
             meta["LB"], meta["VB"], meta["FC"], meta["SC"])
         template = jax.eval_shape(lambda: self._fresh_sharded_carry())
-        leaves, _ = jax.tree_util.tree_flatten_with_path(template)
-        missing = [_leaf_name(kp) for kp, _ in leaves
-                   if _leaf_name(kp) not in z]
-        if missing:
-            raise CheckpointError(
-                f"{path}: checkpoint carry layout is from an "
-                f"incompatible engine version (missing {missing[:3]}"
-                f"{'…' if len(missing) > 3 else ''}) — re-run without "
-                "--resume")
-        host = {(_leaf_name(kp)): z[_leaf_name(kp)] for kp, _ in leaves}
-        carry = jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(template),
-            [self._to_device(host[_leaf_name(kp)])
-             for kp, _ in leaves])
-        if self.store_states and not meta["store_states"]:
-            raise CheckpointError(
-                "checkpoint was written with store_states=False; "
-                "resume with store_states=False")
-        self._parents, self._lanes, self._states = [], [], []
-        if self.store_states and meta["store_states"]:
-            self._parents = [z[f"parents|{i}"]
-                             for i in range(meta["n_levels"])]
-            self._lanes = [z[f"lanes|{i}"]
-                           for i in range(meta["n_levels"])]
-            keys = list(template["lvl"].keys())
-            self._states = [
-                {k: z[f"states|{i}|{k}"] for k in keys}
-                for i in range(meta["n_levels"])]
-        res = CheckResult(
-            distinct_states=meta["distinct"],
-            generated_states=meta["generated"], depth=meta["depth"],
-            level_sizes=list(meta["level_sizes"]),
-            overflow_faults=meta["faults"],
-            violations_global=meta["viol_global"])
-        for nm, sid in zip(z["viol_names"], z["viol_ids"]):
-            res.violations.append(Violation(str(nm), int(sid)))
-        return carry, res, meta
+        carry = ckpt_carry(path, z, template, self._to_device)
+        self._parents, self._lanes, self._states = ckpt_archives(
+            z, meta, template, self.store_states)
+        return carry, ckpt_result(z, meta), meta
 
     def _rehash_sharded(self, carry):
         """Per-shard device rehash into self.VB-slot tables (sharded

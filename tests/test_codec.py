@@ -141,3 +141,35 @@ def test_membership_feature_lanes_match_oracle_predicates():
         assert (feat[C.F_LCDCC] == 0) == \
             P.leader_changes_during_conf_change(sv, h, cfg)
     assert seen_added
+
+
+def test_fingerprint_batch_matches_per_state():
+    """The batch-minor fingerprint formulation (the engine's hot path)
+    is bit-identical to the per-state reference formulation, for both
+    64- and 128-bit streams."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tla_tpu.config import NEXT_ASYNC_CRASH, Bounds, ModelConfig
+    from raft_tla_tpu.engine.fingerprint import Fingerprinter
+    from raft_tla_tpu.models.explore import explore
+
+    cfg = ModelConfig(
+        n_servers=3, init_servers=(0, 1, 2), values=(1, 2),
+        next_family=NEXT_ASYNC_CRASH, symmetry=True,
+        max_inflight_override=6,
+        bounds=Bounds.make(max_log_length=2, max_timeouts=1,
+                           max_client_requests=1))
+    import numpy as np
+    r = explore(cfg, max_states=2000, keep_states=True)
+    lay = Layout(cfg)
+    pairs = list(r.states.values())[:256]
+    arrs = [encode(lay, sv, h) for sv, h in pairs]
+    svb = {k: jnp.asarray(np.stack([a[k] for a in arrs]))
+           for k in arrs[0]}
+    for variant in (cfg, cfg.with_(fp128=True)):
+        fpr = Fingerprinter(variant)
+        ref = np.asarray(
+            jax.jit(lambda s: jax.vmap(fpr.fingerprint)(s))(svb))
+        got = np.asarray(jax.jit(fpr.fingerprint_batch)(svb))
+        assert np.array_equal(ref, got)
