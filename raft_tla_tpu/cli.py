@@ -219,6 +219,7 @@ def cmd_check(args):
         "depth": int(depth),
         "seconds": round(secs, 3),
         "states_per_sec": round(distinct / max(secs, 1e-9), 1),
+        "dedup_hit_rate": round(1.0 - distinct / max(gen, 1), 4),
         "violations": len(viol),
     }
     if args.engine != "oracle":

@@ -152,6 +152,12 @@ class CheckResult:
     def states_per_sec(self):
         return self.distinct_states / max(self.seconds, 1e-9)
 
+    @property
+    def dedup_hit_rate(self):
+        """Fraction of generated successors that were duplicates —
+        TLC's 'distinct vs generated' engine metric (SURVEY §5)."""
+        return 1.0 - self.distinct_states / max(self.generated_states, 1)
+
 
 def _ceil_log2(n: int) -> int:
     return max(1, int(np.ceil(np.log2(max(n, 2)))))
