@@ -223,14 +223,15 @@ def load_model(cfg_path, variant: Optional[str] = None,
     for nm in raw["invariants"]:
         if nm not in OP.INVARIANTS:
             raise CfgError(f"unknown invariant {nm!r}")
-    for nm in raw["constraints"]:
-        if nm in ("CommitWhenConcurrentLeaders_unique",
-                  "MajorityOfClusterRestarts_constraint"):
-            raise CfgError(
-                f"{nm!r} pins the search to a hard-coded trace prefix "
-                f"embedded in the spec (raft.tla:1198-1234); the "
-                f"equivalent here is `check --seed-trace <file>` with a "
-                f"witness emitted by `trace --emit-seed`")
+    # the punctuated-search prefix pins (raft.tla:1198-1234) are cfg
+    # CONSTRAINTS in the reference but compile to BFS seeds here
+    # (models/golden.prefix_pin_seeds) — split them out
+    prefix_pins = tuple(nm for nm in raw["constraints"]
+                        if nm in ("CommitWhenConcurrentLeaders_unique",
+                                  "MajorityOfClusterRestarts_constraint"))
+    plain_constraints = tuple(nm for nm in raw["constraints"]
+                              if nm not in prefix_pins)
+    for nm in plain_constraints:
         if nm not in OP.CONSTRAINTS:
             raise CfgError(f"unknown constraint {nm!r}")
     for nm in raw["action_constraints"]:
@@ -251,7 +252,12 @@ def load_model(cfg_path, variant: Optional[str] = None,
         values=values,
         num_rounds=num_rounds,
         next_family=_NEXT_FAMILIES[next_name],
-        constraints=tuple(raw["constraints"]) or DEFAULT_CONSTRAINTS,
+        # defaults only when the cfg listed NO constraints at all — a
+        # cfg listing only prefix pins gets exactly that (an author who
+        # pinned the search did not ask for the bounded-constraint set)
+        constraints=(plain_constraints if raw["constraints"]
+                     else DEFAULT_CONSTRAINTS),
+        prefix_pins=prefix_pins,
         action_constraints=tuple(raw["action_constraints"]),
         invariants=tuple(raw["invariants"]) or DEFAULT_INVARIANTS,
         symmetry=raw["symmetry"] is not None,

@@ -204,8 +204,16 @@ def cmd_check(args):
         secs = r.seconds
         viol = []
         for v in r.violations[:args.max_violations]:
-            trace = (eng.trace(v.state_id)
-                     if not args.no_store else None)
+            if not args.no_store:
+                trace = eng.trace(v.state_id)
+            elif v.state is not None:
+                # no parent archive, but the violating state itself was
+                # decoded at detection time — always show it (TLC always
+                # reports at least the bad state)
+                trace = [("(violating state; run without --no-store "
+                          "for the full trace)", v.state)]
+            else:
+                trace = None
             viol.append((v.invariant, trace))
         distinct, depth, gen = r.distinct_states, r.depth, \
             r.generated_states

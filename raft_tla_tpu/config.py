@@ -154,6 +154,15 @@ class ModelConfig:
     # 64-bit fingerprints and ~1e-9 collision odds; exhaustive-parity runs
     # can opt into 128 (SURVEY §7.4 hard part 4).
     fp128: bool = False
+    # Punctuated-search prefix pins from the cfg (raft.tla:1198-1234):
+    # "CommitWhenConcurrentLeaders_unique" /
+    # "MajorityOfClusterRestarts_constraint".  The reference evaluates
+    # these as CONSTRAINTs against a hard-coded witness trace embedded in
+    # the spec; the engines compile them into seed states — BFS starts at
+    # the end of the pinned prefix (models/golden.prefix_pin_seeds), which
+    # reproduces TLC's punctuated-search outcome (the witness extensions)
+    # while skipping the prefix interior itself.
+    prefix_pins: Tuple[str, ...] = ()
 
     @property
     def init_mask(self) -> int:

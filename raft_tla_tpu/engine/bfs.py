@@ -53,26 +53,19 @@ from jax import lax
 
 from ..config import CANDIDATE, ModelConfig
 from ..models.raft import Hist, State, init_state
-from ..ops.codec import C_GLOBLEN, C_OVERFLOW, decode, encode
+from ..ops.codec import (C_GLOBLEN, C_OVERFLOW, decode, encode, narrow,
+                         widen)
 from ..ops.kernels import RaftKernels
 from ..ops.layout import Layout
 from ..ops.vpredicates import Predicates
+from ..utils import cat_arrays as _cat
+from ..utils import fmix32_int as _fmix32_int
+from ..utils import fp_key
+from ..utils import take_arrays as _take
 from .expand import Expander
 from .fingerprint import Fingerprinter, combine_u64, fmix32
 
 U32MAX = jnp.uint32(0xFFFFFFFF)
-
-
-def _fmix32_int(x: int) -> int:
-    """Host twin of fingerprint.fmix32 (murmur3 finalizer) on ints."""
-    x &= 0xFFFFFFFF
-    x ^= x >> 16
-    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
-    x ^= x >> 13
-    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
-    x ^= x >> 16
-    return x
-
 
 _HOME_SALT = 0x9E3779B9
 
@@ -103,25 +96,6 @@ def enable_persistent_compilation_cache():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     except Exception:
         pass                  # older jax without the knob: run uncached
-
-
-def _cat(chunks: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
-    return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
-
-
-def fp_key(fp_u32: np.ndarray) -> np.ndarray:
-    """[N, n_streams] u32 -> 1-D sortable dedup key covering ALL streams:
-    plain u64 for the 2-stream default, a lexicographic structured array
-    for fp128 (so the extra streams actually buy collision resistance)."""
-    u64 = combine_u64(fp_u32)                     # [N, n_streams//2]
-    if u64.shape[1] == 1:
-        return u64[:, 0]
-    dtype = np.dtype([(f"w{i}", "<u8") for i in range(u64.shape[1])])
-    return np.ascontiguousarray(u64).view(dtype)[:, 0]
-
-
-def _take(arrs: Dict[str, np.ndarray], idx) -> Dict[str, np.ndarray]:
-    return {k: v[idx] for k, v in arrs.items()}
 
 
 @dataclass
@@ -344,10 +318,21 @@ class Engine:
             max(lcap, 4 * self.chunk, 4 * self.FCAP))
         # open-addressing table: power-of-two capacity (mask indexing)
         self.VCAP = 1 << _ceil_log2(int(vcap))
+        if self.VCAP != int(vcap):
+            import warnings
+            warnings.warn(
+                f"vcap {vcap} rounded up to the next power of two "
+                f"({self.VCAP}) for mask indexing — the visited table "
+                f"allocates {self.VCAP * self.W * 4} bytes",
+                stacklevel=2)
+        # per-family materialization caps (guard-first expansion);
+        # static jit args so growth retraces the step
+        self.FAM_CAPS = tuple(self.expander.default_fam_caps(self.chunk))
         self._rehash_cache = {}
         self._phase1 = jax.jit(self._phase1_impl)
         self._phase2 = jax.jit(self._phase2_impl)
-        self._step_jit = jax.jit(self._chunk_step_impl, donate_argnums=0)
+        self._step_jit = jax.jit(self._chunk_step_impl, donate_argnums=0,
+                                 static_argnums=1)
         self._fin_jit = jax.jit(self._finalize_impl, donate_argnums=0)
         self._rootfp_jit = jax.jit(self.fpr.fingerprint_batch)
 
@@ -396,7 +381,11 @@ class Engine:
             for nm in self.con_names:
                 con = con & self.preds.constraint_fn(nm)(sv, der)
             return inv, con
-        return jax.vmap(one)(svb)
+        # batch-minor (rows vmapped at -1): the tiny per-state minor
+        # dims waste TPU vector tiles batch-major (expand.materialize)
+        svT = {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()}
+        inv, con = jax.vmap(one, in_axes=-1, out_axes=-1)(svT)
+        return jnp.moveaxis(inv, -1, 0), con
 
     # ------------------------------------------------------------------
     # device-resident dedup primitives
@@ -562,7 +551,7 @@ class Engine:
     # fused per-chunk step (ONE device call per frontier chunk)
     # ------------------------------------------------------------------
 
-    def _chunk_step_impl(self, carry):
+    def _chunk_step_impl(self, carry, fam_caps):
         """Expand frontier[base:base+chunk], fingerprint, dedup via the
         visited hash table (claim-insert: intra-chunk first-seen,
         cross-chunk and cross-level membership in ONE probe walk),
@@ -597,21 +586,21 @@ class Engine:
         base = carry["base"]        # device-resident chunk cursor: a
         # host-passed scalar would cost a blocking ~100ms host->device
         # transfer per chunk through the tunneled-TPU runtime
-        sv = {k: lax.dynamic_slice_in_dim(v, base, B)
-              for k, v in carry["front"].items()}
+        # frontier rows are stored narrow (codec.narrow_dtypes); widen
+        # the chunk to the kernels' int32 contract
+        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B)
+                    for k, v in carry["front"].items()})
         fmask = lax.dynamic_slice_in_dim(carry["fmask"], base, B)
-        ok, cand = lax.optimization_barrier(
-            self.expander._expand_impl(sv))               # [B,A], [B,A,…]
-        if self.act_names:
-            act = jax.vmap(lambda p, crow: jax.vmap(
-                lambda c: self._act_ok(p, c))(crow))(sv, cand)
-            ok = ok & act
+        # guard-first expansion: guards over the whole lane grid (the
+        # successor construction is DCE'd), successors materialized only
+        # for enabled lanes (expand.Expander.materialize)
+        derb = self.expander.derived_batch(sv)
+        ok = lax.optimization_barrier(self.expander.guards(sv, derb))
         # fmask carries both the live-row bound and the CONSTRAINT
         # prune-not-expand mask (SURVEY §2.8)
         valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
                  carry["n_front"]) & fmask
         okf = (ok & valid[:, None]).reshape(N)
-        n_gen = carry["n_gen"] + okf.sum(dtype=jnp.int32)
 
         # compact enabled lanes into FCAP (ascending lane index =
         # the oracle's successor enumeration order)
@@ -619,15 +608,24 @@ class Engine:
         epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1,
                          FCAP)                           # OOB drops
         n_e = okf.sum(dtype=jnp.int32)
-        fovf = carry["fovf"] | (n_e > FCAP)
         eidx = lax.optimization_barrier(
             jnp.full((FCAP,), N, jnp.int32).at[epos].set(
                 idx, mode="drop"))                       # slot -> lane
+        cand_c, famx = self.expander.materialize(
+            sv, derb, okf, epos, FCAP, fam_caps)
+        cand_c = lax.optimization_barrier(cand_c)        # [FCAP, …]
+        famx = jnp.maximum(carry["famx"], famx)
+        fovf = carry["fovf"] | (n_e > FCAP) | \
+            jnp.any(famx > jnp.asarray(fam_caps, jnp.int32))
         elive = jnp.arange(FCAP, dtype=jnp.int32) < n_e
         take = jnp.clip(eidx, 0, N - 1)
-        cand_c = lax.optimization_barrier(
-            {k: v.reshape((N,) + v.shape[2:])[take]
-             for k, v in cand.items()})                  # [FCAP, …]
+        if self.act_names:
+            # ACTION_CONSTRAINTS on the compacted (parent, successor)
+            # pairs: violating transitions are killed before dedup
+            par_c = {k: v[take // A] for k, v in sv.items()}
+            act = jax.vmap(self._act_ok)(par_c, cand_c)
+            elive = elive & act
+        n_gen = carry["n_gen"] + elive.sum(dtype=jnp.int32)
 
         # fingerprint only the compacted candidates
         fp = lax.optimization_barrier(
@@ -673,7 +671,8 @@ class Engine:
         # invariants + constraints on the fresh rows (garbage rows are
         # masked by n_lvl at finalize)
         inv, con = lax.optimization_barrier(self._phase2_impl(rows))
-        lvl = {k: lax.dynamic_update_slice_in_dim(v, rows[k], start, 0)
+        rows_n = narrow(self.lay, rows)        # storage dtypes for lvl
+        lvl = {k: lax.dynamic_update_slice_in_dim(v, rows_n[k], start, 0)
                for k, v in carry["lvl"].items()}
         # parent global ids are arithmetic: frontier row r has id
         # pg_off + r (the frontier IS the previous level, uncompacted)
@@ -691,7 +690,7 @@ class Engine:
                     n_lvl=jnp.minimum(carry["n_lvl"] + n_fresh,
                                       LCAP - FCAP),
                     n_gen=n_gen, ovf=ovf, fovf=fovf, hovf=hovf,
-                    base=base + B)
+                    famx=famx, base=base + B)
 
     # ------------------------------------------------------------------
     # per-level finalize: scalar aggregation, next-frontier swap,
@@ -755,15 +754,20 @@ class Engine:
         front, lvl, fmask, n_front, vis, pg_off, g_next = lax.cond(
             bad, abandon, commit, carry)
         n_expand = (con & validrow).sum(dtype=jnp.int32)
-        scal = jnp.stack([
+        # scal tail carries the per-family enabled-count maxima so the
+        # host can grow exactly the overflowing family caps (still ONE
+        # device→host transfer per level)
+        scal = jnp.concatenate([jnp.stack([
             n_lvl, n_viol, faults, n_front,
             carry["ovf"].astype(jnp.int32), carry["fovf"].astype(jnp.int32),
-            carry["n_gen"], n_expand, carry["hovf"].astype(jnp.int32)])
+            carry["n_gen"], n_expand, carry["hovf"].astype(jnp.int32)]),
+            carry["famx"]])
         new_carry = dict(carry, vis=vis, front=front, lvl=lvl,
                          fmask=fmask, n_front=n_front,
                          n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
                          ovf=jnp.bool_(False), fovf=jnp.bool_(False),
                          hovf=jnp.bool_(False),
+                         famx=jnp.zeros_like(carry["famx"]),
                          base=jnp.int32(0), pg_off=pg_off, g_off=g_next)
         return new_carry, dict(inv_ok=inv_ok, scal=scal)
 
@@ -771,7 +775,7 @@ class Engine:
 
     def _fresh_carry(self, lcap: int, vcap: int, fcap: Optional[int] = None):
         fcap = fcap if fcap is not None else self.FCAP
-        one = encode(self.lay, *init_state(self.cfg))
+        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
         zeros = {k: jnp.zeros((lcap,) + v.shape, dtype=v.dtype)
                  for k, v in one.items()}
         n_inv = len(self.inv_names)
@@ -788,6 +792,7 @@ class Engine:
             cidx=jnp.zeros((fcap,), jnp.int32),   # FCAP shape anchor
             n_lvl=jnp.int32(0),
             n_gen=jnp.int32(0),
+            famx=jnp.zeros((len(self.expander.families),), jnp.int32),
             base=jnp.int32(0),      # chunk cursor within the frontier
             g_off=jnp.int32(0),     # global state-id offset (this level)
             pg_off=jnp.int32(0),    # global state-id offset (frontier)
@@ -854,6 +859,11 @@ class Engine:
             n_front = meta["n_front"]
             resumed = True
         else:
+            if seed_states is None and self.cfg.prefix_pins:
+                # cfg-declared punctuated-search pins compile to seeds
+                # (raft.tla:1198-1234; models/golden docstring)
+                from ..models.golden import prefix_pin_seeds
+                seed_states = prefix_pin_seeds(self.cfg)
             init_list = (seed_states if seed_states is not None
                          else [init_state(self.cfg)])
             init_arrs = _cat([
@@ -861,6 +871,7 @@ class Engine:
                 if isinstance(s, dict) else
                 {k: v[None] for k, v in encode(lay, *s).items()}
                 for s in init_list])
+            init_arrs = widen(init_arrs)   # kernels'/fp int32 contract
             rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
             root_fp = np.asarray(self._rootfp_jit(rootsb))
             root_keys = fp_key(root_fp)
@@ -882,10 +893,11 @@ class Engine:
             # probe placement — the table is empty, so the sequential
             # simulation is exact) and finalize.
             pad = self.LCAP - n_roots
+            roots_n = narrow(self.lay, widen(roots))   # storage dtypes
             carry["lvl"] = {k: jnp.asarray(np.concatenate(
-                [roots[k], np.zeros((pad,) + roots[k].shape[1:],
-                                    roots[k].dtype)]))
-                for k in roots}
+                [roots_n[k], np.zeros((pad,) + roots_n[k].shape[1:],
+                                      roots_n[k].dtype)]))
+                for k in roots_n}
             rk = np.asarray(root_fp[first_idx], dtype=np.uint32)
             slots = self._host_probe_assign(rk)
             sl = jnp.asarray(slots)
@@ -983,7 +995,7 @@ class Engine:
             while True:
                 n_chunks = (n_front + self.chunk - 1) // self.chunk
                 for _ in range(n_chunks):
-                    carry = self._step_jit(carry)
+                    carry = self._step_jit(carry, self.FAM_CAPS)
                 carry, out, scal = run_finalize(carry)
                 ovf, fovf, hovf = (bool(scal[4]), bool(scal[5]),
                                    bool(scal[8]))
@@ -996,7 +1008,20 @@ class Engine:
                 # fewer, larger steps.
                 old_caps = (self.LCAP, self.FCAP)
                 if fovf:
-                    self.FCAP *= 4
+                    # grow exactly the overflowing family caps (famx in
+                    # the scal tail); grow FCAP only if the TOTAL
+                    # enabled count blew the compaction buffer
+                    famx = scal[9:9 + len(self.FAM_CAPS)]
+                    caps = list(self.FAM_CAPS)
+                    fam_over = False
+                    for fi, fam in enumerate(self.expander.families):
+                        hard = fam.n_lanes * self.chunk
+                        while caps[fi] < hard and famx[fi] > caps[fi]:
+                            caps[fi] = min(2 * caps[fi], hard)
+                            fam_over = True
+                    self.FAM_CAPS = tuple(caps)
+                    if not fam_over:
+                        self.FCAP *= 4
                 if ovf or self.LCAP < 4 * self.FCAP:
                     self.LCAP = self._round_cap(
                         max((4 * self.LCAP) if ovf else self.LCAP,
@@ -1057,14 +1082,16 @@ class Engine:
                    self._lanes, self._states, res, dict(
                        depth=depth, n_states=n_states, n_vis=n_vis,
                        n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
-                       FCAP=self.FCAP, chunk=self.chunk,
-                       cfg=repr(self.cfg)))
+                       FCAP=self.FCAP, fam_caps=list(self.FAM_CAPS),
+                       chunk=self.chunk, cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
-                            ("LCAP", "VCAP", "FCAP"), sharded=False)
+                            ("LCAP", "VCAP", "FCAP", "fam_caps"),
+                            sharded=False)
         self.LCAP, self.VCAP, self.FCAP = (meta["LCAP"], meta["VCAP"],
                                            meta["FCAP"])
+        self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])
         # eval_shape: the template is only read for structure/key paths,
         # never materialized (a real _fresh_carry would transiently
         # double device memory at resume)
@@ -1073,7 +1100,9 @@ class Engine:
         carry = ckpt_carry(path, z, template, jnp.asarray)
         self._parents, self._lanes, self._states = ckpt_archives(
             z, meta, template, self.store_states)
-        return carry, ckpt_result(z, meta), meta
+        res = ckpt_result(z, meta)
+        z.close()             # all arrays extracted; don't leak the fd
+        return carry, res, meta
 
     # ------------------------------------------------------------------
 

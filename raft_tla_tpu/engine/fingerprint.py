@@ -18,6 +18,25 @@ H hashes positional fields with per-position salts and the message bag
 — or a message split across slots — never affects identity and no
 canonical bag sort exists anywhere in the engine (ops/layout.py).
 
+Hot-path formulation (the engine fingerprints every fresh candidate, so
+this dominated profiles): because the positional hash is a commutative
+sum Σ_t fmix(relabeled[t] ^ salt[t]), relabeling the *state* is
+equivalent to permuting the *salts*:
+
+  Σ_t fmix(view(σ(s))[t] ^ salt[t])  =  Σ_p fmix(content_σ(s)[p] ^ salt[σ(p)])
+
+so instead of gathering every state array through the inverse
+permutation per σ (the old formulation — P gathers of the whole state
+per candidate), the engine precomputes P statically-permuted salt
+tables at init and hashes the state IN PLACE.  Only fields whose
+*values* carry server labels still need per-σ work: votedFor, the
+vote bitmasks, ConfigEntry payloads, and message src/dst/mserver.
+Message slots are unpacked ONCE (perm-independent) and per σ only the
+three label fields are re-packed into the header word.  The resulting
+fingerprints are bit-identical to the naive relabel-then-hash form
+(tests/test_codec.py asserts batch/per-state identity; the engine's
+differential suites pin the semantics).
+
 64-bit fingerprints are two independent 32-bit murmur-finalizer streams
 (no jax x64 dependency); ``fp128`` doubles the streams (SURVEY §7.4
 hard part 4: TLC-style collision odds vs exhaustiveness claims).
@@ -34,7 +53,7 @@ import numpy as np
 from ..config import CONFIG_ENTRY, MT_COC, NIL, ModelConfig
 from ..models.explore import symmetry_perms
 from ..ops.kernels import RaftKernels
-from ..ops.layout import Layout
+from ..ops.layout import Layout, get_field, put_field
 
 U32 = jnp.uint32
 
@@ -77,6 +96,33 @@ class Fingerprinter:
             for i, t in enumerate(sig):
                 invs[p, t] = i
         self.invs = invs
+        # statically permuted salt tables: psalts[p, t, i] is the salt a
+        # value at original flat position i hashes against under σ_p —
+        # i.e. pos_salts[t][σ_p(position i)]; per-server blocks permute
+        # by σ(i), log by (σ(i), l), ni/mi by (σ(i), σ(j)).
+        idx = np.empty((len(perms), self.n_pos), dtype=np.int64)
+        ar = np.arange(S)
+        for p, sig in enumerate(np.asarray(self.sigmas)):
+            off = 0
+            for _blk in range(5):                        # ct st vf ci llen
+                idx[p, off:off + S] = off + sig[ar]
+                off += S
+            blk = (sig[ar][:, None] * Lcap +
+                   np.arange(Lcap)[None, :]).reshape(-1)  # log
+            idx[p, off:off + S * Lcap] = off + blk
+            off += S * Lcap
+            for _blk in range(2):                        # vr vg
+                idx[p, off:off + S] = off + sig[ar]
+                off += S
+            blk2 = (sig[ar][:, None] * S + sig[ar][None, :]).reshape(-1)
+            for _blk in range(2):                        # ni mi
+                idx[p, off:off + S * S] = off + blk2
+                off += S * S
+            assert off == self.n_pos
+        self.psalts = np.stack(
+            [np.stack([self.pos_salts[t][idx[p]]
+                       for t in range(self.n_streams)])
+             for p in range(len(perms))])          # [P, n_streams, n_pos]
 
     # ------------------------------------------------------------------
 
@@ -86,70 +132,130 @@ class Fingerprinter:
             out = out | (((m >> i) & 1) << sigma[i])
         return out
 
-    def _perm_entry(self, e, sigma):
-        kern = self.kern
-        is_cfg = (kern.entry_type(e) == CONFIG_ENTRY) & (e != 0)
-        payload = kern.entry_payload(e)
-        permuted = kern.pack_entry(kern.entry_term(e), kern.entry_type(e),
-                                   self._perm_mask(payload, sigma))
-        return jnp.where(is_cfg, permuted, e)
+    # ------------------------------------------------------------------
+    # shared hashing core.  svT holds the VIEW arrays with their
+    # canonical leading axes ([S], [S,Lcap], [K,MW], [K]) and `nb`
+    # trailing batch axes (0 for the per-state path, 1 for the batched
+    # engine path — batch axis LAST so position reductions stay major).
+    # ------------------------------------------------------------------
 
-    def _relabel_view(self, sv: Dict, sigma, inv) -> List[jnp.ndarray]:
-        """Permuted VIEW as a flat list: positional arrays + (bag, cnt)."""
-        kern = self.kern
-        vf = sv["vf"][inv]
-        vf = jnp.where(vf >= 0, sigma[jnp.clip(vf, 0, self.lay.S - 1)], NIL)
-        log = self._perm_entry(sv["log"][inv], sigma)
-        positional = [
-            sv["ct"][inv], sv["st"][inv], vf, sv["ci"][inv],
-            sv["llen"][inv], log,
-            self._perm_mask(sv["vr"][inv], sigma),
-            self._perm_mask(sv["vg"][inv], sigma),
-            sv["ni"][inv][:, inv], sv["mi"][inv][:, inv],
-        ]
+    def _core(self, svT: Dict, nb: int) -> jnp.ndarray:
+        lay, kern = self.lay, self.kern
+        S, Lcap, K = lay.S, lay.Lcap, lay.K
+        hs = lay.header_shifts
+        tail = (1,) * nb                   # broadcast shape for salts
 
-        def perm_slot(words):
-            f = kern.msg_fields(words)
-            src = sigma[jnp.clip(f["msrc"], 0, self.lay.S - 1)]
-            dst = sigma[jnp.clip(f["mdst"], 0, self.lay.S - 1)]
-            b = jnp.where(
-                f["mtype"] == MT_COC,
-                sigma[jnp.clip(f["b"], 0, self.lay.S - 1)], f["b"])
-            ent = self._perm_entry(f["ent"], sigma)
-            empty = f["mtype"] == 0
-            repacked = kern.pack_msg(f["mtype"], f["mterm"], src, dst,
-                                     a=f["a"], b=b, c=f["c"], ent=ent,
-                                     entlen=f["entlen"])
-            return jnp.where(empty, words, repacked)
+        # ---- perm-independent precompute (hoisted out of the σ loop) --
+        bag = svT["bag"]                                  # [K, MW, ...]
+        w0 = bag[:, 0]
+        mtype = get_field(w0, hs["mtype"]).astype(jnp.int32)
+        src = get_field(w0, hs["msrc"]).astype(jnp.int32)
+        dst = get_field(w0, hs["mdst"]).astype(jnp.int32)
+        braw = get_field(w0, hs["b"]).astype(jnp.int32)   # stored +1
+        clear = U32(0xFFFFFFFF) ^ U32(
+            put_field(0xFFFFFFFF, hs["msrc"]) |
+            put_field(0xFFFFFFFF, hs["mdst"]) |
+            put_field(0xFFFFFFFF, hs["b"]))
+        w0_base = w0 & clear
+        empty = mtype == 0
+        is_coc = mtype == MT_COC
+        ebits, epw = lay.entry_bits, lay.entries_per_word
+        emask = (1 << ebits) - 1
+        ent = jnp.stack([
+            ((bag[:, 1 + k // epw] >> (ebits * (k % epw))) & emask)
+            .astype(jnp.int32)
+            for k in range(lay.Lmax)], axis=1) if lay.msg_words > 1 \
+            else jnp.zeros((K, 0) + w0.shape[1:], jnp.int32)  # [K,Lmax,...]
+        vmask = (1 << lay.value_bits) - 1
 
-        bag = jax.vmap(perm_slot)(sv["bag"])
-        return positional, bag
+        def split_cfg(e):
+            """entry -> (is_cfg, payload-cleared base, payload)."""
+            is_cfg = (kern.entry_type(e) == CONFIG_ENTRY) & (e != 0)
+            return is_cfg, e & ~jnp.int32(vmask), e & vmask
 
-    def _hash_streams(self, positional, bag, cnt) -> jnp.ndarray:
-        flat = jnp.concatenate(
-            [p.reshape(-1).astype(U32) for p in positional])
-        out = []
+        ent_cfg, ent_base, ent_pay = split_cfg(ent)
+        log = svT["log"]                                  # [S, Lcap, ...]
+        log_cfg, log_base, log_pay = split_cfg(log)
+        vf = svT["vf"]
+        cnt = svT["cnt"].astype(U32)                      # [K, ...]
+        const_flat = [svT["ct"], svT["st"], None, svT["ci"], svT["llen"],
+                      None, None, None, svT["ni"], svT["mi"]]
+
+        def one_perm(sigma, psalt):
+            # ---- label-carrying content, relabeled under σ ----
+            vfp = jnp.where(vf >= 0,
+                            sigma[jnp.clip(vf, 0, S - 1)], NIL)
+            vrp = self._perm_mask(svT["vr"], sigma)
+            vgp = self._perm_mask(svT["vg"], sigma)
+            logp = jnp.where(log_cfg,
+                             log_base | self._perm_mask(log_pay, sigma),
+                             log)
+            pieces = list(const_flat)
+            pieces[2], pieces[5], pieces[6], pieces[7] = vfp, logp, vrp, vgp
+            flat = jnp.concatenate(
+                [p.reshape((-1,) + p.shape[p.ndim - nb:]).astype(U32)
+                 for p in pieces])                        # [n_pos, ...]
+
+            # ---- bag header/entry repack (only label fields change) --
+            srcp = sigma[jnp.clip(src, 0, S - 1)]
+            dstp = sigma[jnp.clip(dst, 0, S - 1)]
+            bp = jnp.where(is_coc,
+                           sigma[jnp.clip(braw - 1, 0, S - 1)] + 1, braw)
+            w0p = (w0_base |
+                   put_field(srcp.astype(U32), hs["msrc"]) |
+                   put_field(dstp.astype(U32), hs["mdst"]) |
+                   put_field(bp.astype(U32), hs["b"]))
+            w0p = jnp.where(empty, w0, w0p)
+            entp = jnp.where(ent_cfg,
+                             ent_base | self._perm_mask(ent_pay, sigma),
+                             ent)
+            words = [w0p]
+            for w in range(1, lay.msg_words):
+                acc = jnp.zeros_like(w0)
+                for k in range((w - 1) * epw, min(w * epw, lay.Lmax)):
+                    acc = acc | (entp[:, k].astype(U32)
+                                 << (ebits * (k % epw)))
+                words.append(jnp.where(empty, bag[:, w], acc))
+
+            # ---- per-stream reduction ----
+            out = []
+            for t in range(self.n_streams):
+                h = jnp.sum(fmix32(flat ^ psalt[t].reshape(
+                    (self.n_pos,) + tail)), axis=0)
+                bs = jnp.asarray(self.bag_salts[t])
+                slot = jnp.zeros_like(w0)
+                for w in range(lay.msg_words):
+                    slot = slot + fmix32(words[w] ^ bs[w])
+                h = h + jnp.sum(cnt * fmix32(slot ^ bs[-1]), axis=0)
+                out.append(h)
+            return jnp.stack(out)                 # [n_streams, ...]
+
+        hs_all = jax.vmap(one_perm)(
+            jnp.asarray(self.sigmas),
+            jnp.asarray(self.psalts))             # [P, n_streams, ...]
+        best = self._lex_min(hs_all)
+        # the engines' visited tables use the all-ones key as the
+        # empty-slot sentinel; an all-ones fingerprint would alias it
+        # and be re-admitted as fresh on EVERY regeneration (unlike an
+        # ordinary fp collision, which miscounts once).  Remap it to a
+        # fixed alternate so the sentinel is unreachable by real keys.
+        allones = jnp.ones(best.shape[1:], bool)
         for t in range(self.n_streams):
-            h = jnp.sum(fmix32(flat ^ jnp.asarray(self.pos_salts[t])))
-            bs = jnp.asarray(self.bag_salts[t])
-            slot = jnp.zeros((bag.shape[0],), U32)
-            for w in range(self.lay.msg_words):
-                slot = slot + fmix32(bag[:, w] ^ bs[w])
-            h = h + jnp.sum(cnt.astype(U32) * fmix32(slot ^ bs[-1]))
-            out.append(h)
-        return jnp.stack(out)                        # [n_streams] u32
+            allones = allones & (best[t] == U32(0xFFFFFFFF))
+        return best.at[self.n_streams - 1].set(
+            jnp.where(allones, U32(0xFFFFFFFE), best[self.n_streams - 1]))
 
     def fingerprint(self, sv: Dict) -> jnp.ndarray:
         """Single state -> u32[n_streams], min over the symmetry group
         (lexicographic order on the stream vector)."""
+        return self._core(sv, nb=0)
 
-        def one_perm(sigma, inv):
-            positional, bag = self._relabel_view(sv, sigma, inv)
-            return self._hash_streams(positional, bag, sv["cnt"])
-
-        hs = jax.vmap(one_perm)(jnp.asarray(self.sigmas),
-                                jnp.asarray(self.invs))   # [P, streams]
-        return self._lex_min(hs)
+    def fingerprint_batch(self, svb: Dict) -> jnp.ndarray:
+        """[B, ...] batch -> u32[B, n_streams]; bit-identical to
+        vmap(fingerprint) (tests/test_codec.py asserts this) but with
+        the batch axis minor so the position reduction vectorizes."""
+        svT = {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()}
+        return self._core(svT, nb=1).T            # [B, n_streams]
 
     def _lex_min(self, hs) -> jnp.ndarray:
         """[P, n_streams, ...] -> [n_streams, ...]: lexicographic min
@@ -166,45 +272,6 @@ class Fingerprinter:
                 eq = eq & (cand[t] == best[t])
             best = jnp.where(less, cand, best)
         return best
-
-    def _hash_streams_cols(self, positional, bag, cnt) -> jnp.ndarray:
-        """Batched twin of _hash_streams with the batch axis LAST:
-        positional entries are [..., B], bag is [K, msg_words, B],
-        cnt is [K, B]."""
-        B = cnt.shape[-1]
-        flat = jnp.concatenate(
-            [p.astype(U32).reshape(-1, B) for p in positional], axis=0)
-        out = []
-        for t in range(self.n_streams):
-            salts = jnp.asarray(self.pos_salts[t])[:, None]
-            h = jnp.sum(fmix32(flat ^ salts), axis=0)
-            bs = jnp.asarray(self.bag_salts[t])
-            slot = jnp.zeros(cnt.shape, U32)
-            for w in range(self.lay.msg_words):
-                slot = slot + fmix32(bag[:, w, :] ^ bs[w])
-            h = h + jnp.sum(cnt.astype(U32) * fmix32(slot ^ bs[-1]),
-                            axis=0)
-            out.append(h)
-        return jnp.stack(out)                        # [n_streams, B]
-
-    def fingerprint_batch(self, svb: Dict) -> jnp.ndarray:
-        """[B, ...] batch -> u32[B, n_streams]; bit-identical to
-        vmap(fingerprint) (tests/test_codec.py asserts this) but
-        computed with the batch axis minor.  _relabel_view is
-        shape-polymorphic — indexing/bit ops act on leading axes — so
-        only the hash reduction needs the columns variant.  (Measured
-        perf-neutral vs the vmapped form on v5e at S=3 — XLA handles
-        the batch-major layout better than expected — but this is the
-        engine's canonical batched entry point.)"""
-        svT = {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()}
-
-        def one_perm(sigma, inv):
-            positional, bag = self._relabel_view(svT, sigma, inv)
-            return self._hash_streams_cols(positional, bag, svT["cnt"])
-
-        hs = jax.vmap(one_perm)(jnp.asarray(self.sigmas),
-                                jnp.asarray(self.invs))  # [P, streams, B]
-        return self._lex_min(hs).T                   # [B, n_streams]
 
 
 def combine_u64(fp: np.ndarray) -> np.ndarray:

@@ -164,6 +164,11 @@ def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
             sv = canonicalize(sv, perms, cfg)
         return sv
 
+    if seed_states is None and cfg.prefix_pins:
+        # cfg-declared punctuated-search pins compile to seeds
+        # (raft.tla:1198-1234; models/golden docstring)
+        from .golden import prefix_pin_seeds
+        seed_states = prefix_pin_seeds(cfg)
     roots = (seed_states if seed_states is not None
              else [init_state(cfg)])
     seen: Dict = {}

@@ -27,13 +27,18 @@ get ids ``g_base + prefix[d] + row`` where ``prefix`` is the exclusive
 cumsum of the per-device level counts (computed on device with an
 ``all_gather``).  The host reads ONE packed per-level scalar matrix.
 
-Determinism caveat (shared with TLC's multi-worker mode): when two
-candidates have equal VIEW fingerprints but different non-VIEW history
-counters, WHICH concrete state survives depends on arrival order.
-Under ``VIEW``-insensitive constraint sets the reachable set is
-unaffected; with counter-dependent constraints (BoundedTimeouts etc.)
-multi-worker TLC has the same nondeterminism.  The sharded differential
-test therefore runs a counter-free constraint set.
+Determinism (cf. TLC's multi-worker mode): the admit order is a fixed
+function of (mesh size, chunk, BFS content) — the all_to_all receive
+layout is [src_device, send_rank] and claims tie-break by that rank —
+so a run is DETERMINISTIC for a fixed worker count.  What may differ
+from the single-worker order is which concrete representative survives
+among candidates with equal VIEW fingerprints but different non-VIEW
+history counters (exactly TLC's multi-worker caveat).  Empirically the
+counts still match the oracle exactly on the unmodified reference cfg
+with its full counter-dependent constraint set
+(tests/test_sharded.py::test_sharded_reference_cfg_full_constraints);
+the VIEW-only-constraint differential tests pin the order-insensitive
+case.
 """
 
 from __future__ import annotations
@@ -66,7 +71,7 @@ from ..engine.bfs import (CheckResult, Engine, U32MAX, Violation, _cat,
                           _take, ckpt_archives, ckpt_carry, ckpt_read,
                           ckpt_result, ckpt_write)
 from ..models.raft import init_state
-from ..ops.codec import C_OVERFLOW, decode, encode
+from ..ops.codec import C_OVERFLOW, decode, encode, narrow, widen
 
 
 class ShardedEngine(Engine):
@@ -100,24 +105,28 @@ class ShardedEngine(Engine):
         # its usable capacity
         self.LB = self._round_lb(max(lcap // self.D, 4 * self.FC,
                                      2 * self.D * self.SC))
+        # per-family materialization caps are per-DEVICE (chunk/D rows)
+        self.FAM_CAPS = tuple(self.expander.default_fam_caps(self.BL))
         self._level_jit = jax.jit(self._sharded_level_call,
-                                  donate_argnums=0)
+                                  donate_argnums=0, static_argnums=1)
 
     def _round_lb(self, n: int) -> int:
         b = self.BL
         return ((int(n) + b - 1) // b) * b
 
     # -----------------------------------------------------------------
-    def _sharded_level_call(self, carry):
+    def _sharded_level_call(self, carry, fam_caps):
         specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
         # scal is all-gathered on device and comes back REPLICATED so
-        # every controller process can read the whole [D, 10] matrix
-        # without touching non-addressable shards (multi-host safe)
+        # every controller process can read the whole [D, 10+n_fams]
+        # matrix without touching non-addressable shards (multi-host
+        # safe)
         out_specs = (specs, dict(inv_ok=P("d"), scal=P(None)))
-        return _shard_map(self._shard_level, self.mesh,
-                          (specs,), out_specs)(carry)
+        return _shard_map(
+            lambda c: self._shard_level(c, fam_caps), self.mesh,
+            (specs,), out_specs)(carry)
 
-    def _shard_level(self, carry):
+    def _shard_level(self, carry, fam_caps):
         """Whole BFS level in one device call: while any device still
         has frontier rows and no device overflowed, run lock-step chunk
         steps (the all_to_all inside needs every device participating —
@@ -133,7 +142,8 @@ class ShardedEngine(Engine):
             flags = jax.lax.all_gather(jnp.stack([more, bad]), "d")
             return flags[:, 0].any() & ~flags[:, 1].any()
 
-        c = lax.while_loop(cond, self._local_step, c)
+        c = lax.while_loop(cond, lambda cc: self._local_step(cc, fam_caps),
+                           c)
         new_c, out = self._local_finalize(c)
         return (jax.tree_util.tree_map(lambda x: x[None], new_c),
                 dict(inv_ok=out["inv_ok"][None], scal=out["scal"]))
@@ -143,41 +153,46 @@ class ShardedEngine(Engine):
     # leaves are the local shard, device axis stripped)
     # -----------------------------------------------------------------
 
-    def _local_step(self, c):
+    def _local_step(self, c, fam_caps):
         B, A, W, D = self.BL, self.A, self.W, self.D
         # capacities derive from carry shapes so growth always retraces
+        # (fam_caps rides as a static jit arg instead)
         FC = c["cidx"].shape[0]
         SC = c["sscr"].shape[0]
         LB = c["fmask"].shape[0]
         N = B * A
         M = D * SC                     # received candidates per step
         base = c["base"]
-        sv = {k: lax.dynamic_slice_in_dim(v, base, B)
-              for k, v in c["front"].items()}
+        # frontier shards are stored narrow; widen the chunk for kernels
+        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B)
+                    for k, v in c["front"].items()})
         fmask = lax.dynamic_slice_in_dim(c["fmask"], base, B)
-        ok, cand = lax.optimization_barrier(
-            self.expander._expand_impl(sv))
-        if self.act_names:
-            act = jax.vmap(lambda p, crow: jax.vmap(
-                lambda cc: self._act_ok(p, cc))(crow))(sv, cand)
-            ok = ok & act
+        # guard-first expansion (engine/bfs chunk-step twin)
+        derb = self.expander.derived_batch(sv)
+        ok = lax.optimization_barrier(self.expander.guards(sv, derb))
         valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
                  c["n_front"]) & fmask
         okf = (ok & valid[:, None]).reshape(N)
-        n_gen = c["n_gen"] + okf.sum(dtype=jnp.int32)
 
-        # compact enabled lanes, fingerprint them
+        # compact enabled lanes, materialize, fingerprint them
         idx = jnp.arange(N, dtype=jnp.int32)
         epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1, FC)
         n_e = okf.sum(dtype=jnp.int32)
-        fovf = c["fovf"] | (n_e > FC)
         eidx = lax.optimization_barrier(
             jnp.full((FC,), N, jnp.int32).at[epos].set(idx, mode="drop"))
+        cand_c, famx = self.expander.materialize(
+            sv, derb, okf, epos, FC, fam_caps)
+        cand_c = lax.optimization_barrier(cand_c)
+        famx = jnp.maximum(c["famx"], famx)
+        fovf = c["fovf"] | (n_e > FC) | \
+            jnp.any(famx > jnp.asarray(fam_caps, jnp.int32))
         elive = jnp.arange(FC, dtype=jnp.int32) < n_e
         take = jnp.clip(eidx, 0, N - 1)
-        cand_c = lax.optimization_barrier(
-            {k: v.reshape((N,) + v.shape[2:])[take]
-             for k, v in cand.items()})
+        if self.act_names:
+            par_c = {k: v[take // A] for k, v in sv.items()}
+            act = jax.vmap(self._act_ok)(par_c, cand_c)
+            elive = elive & act
+        n_gen = c["n_gen"] + elive.sum(dtype=jnp.int32)
         fp = lax.optimization_barrier(
             self.fpr.fingerprint_batch(cand_c))            # [FC, W]
         pgid = c["pg_off"] + base + take // A
@@ -205,7 +220,10 @@ class ShardedEngine(Engine):
         stake = jnp.clip(sidx, 0, FC - 1)
         send_key = tuple(jnp.where(sfill, fp[stake, w], U32MAX)
                          for w in range(W))
-        send_row = {k: v[stake] for k, v in cand_c.items()}
+        # rows ride the ICI all_to_all in storage dtypes (2-3x fewer
+        # interconnect bytes than the kernels' int32 rows)
+        send_row = narrow(self.lay, {k: v[stake]
+                                     for k, v in cand_c.items()})
         send_pgid = jnp.where(sfill, pgid[stake], -1)
         send_lane = jnp.where(sfill, lane[stake], -1)
         (send_key, send_row, send_pgid, send_lane) = \
@@ -226,7 +244,10 @@ class ShardedEngine(Engine):
         recv_live = jnp.zeros(M, bool)
         for w in range(W):
             recv_live = recv_live | (recv_key[w] != U32MAX)
-        gate = ~(c["ovf"] | c["fovf"] | c["sovf"] | c["hovf"])
+        # include the CURRENT step's fovf/sovf (not just prior-step
+        # flags): a step that overflowed its compaction or send buffer
+        # is doomed to replay, so its claim-inserts are wasted writes
+        gate = ~(c["ovf"] | fovf | sovf | c["hovf"])
         ranks = jnp.arange(M, dtype=jnp.uint32)
         table, claims, fresh, pos, hv = self._probe_insert(
             c["vis"], c["claims"], recv_key, recv_live & gate, ranks)
@@ -250,8 +271,9 @@ class ShardedEngine(Engine):
 
         start = jnp.minimum(c["n_lvl"], LB - M)
         rows = lax.optimization_barrier(
-            {k: recv_row[k][lidx] for k in recv_row})
-        inv, con = lax.optimization_barrier(self._phase2_impl(rows))
+            {k: recv_row[k][lidx] for k in recv_row})   # narrow
+        inv, con = lax.optimization_barrier(
+            self._phase2_impl(widen(rows)))
         lvl = {k: lax.dynamic_update_slice_in_dim(v, rows[k], start, 0)
                for k, v in c["lvl"].items()}
         lpar = lax.dynamic_update_slice_in_dim(
@@ -266,7 +288,7 @@ class ShardedEngine(Engine):
                     llane=llane, jslot=jslot, linv=linv, lcon=lcon,
                     n_lvl=jnp.minimum(c["n_lvl"] + n_fresh, LB - M),
                     n_gen=n_gen, ovf=ovf, fovf=fovf, sovf=sovf,
-                    hovf=hovf, base=base + B)
+                    hovf=hovf, famx=famx, base=base + B)
 
     # -----------------------------------------------------------------
 
@@ -308,19 +330,21 @@ class ShardedEngine(Engine):
 
         front, lvl, fmask, n_front, vis, pg_off, g_next = lax.cond(
             bad, abandon, commit, c)
-        # [D, 10] replicated via all_gather so every controller process
-        # reads the full matrix (multi-host safe; out_specs P(None))
-        scal = jax.lax.all_gather(jnp.stack([
+        # [D, 10+n_fams] replicated via all_gather so every controller
+        # process reads the full matrix (multi-host safe; out_specs
+        # P(None)); the famx tail drives per-family cap growth
+        scal = jax.lax.all_gather(jnp.concatenate([jnp.stack([
             n_lvl, n_viol, faults, n_front,
             c["ovf"].astype(jnp.int32), c["fovf"].astype(jnp.int32),
             c["n_gen"], (con & validrow).sum(dtype=jnp.int32),
             c["sovf"].astype(jnp.int32), c["hovf"].astype(jnp.int32)]),
-            "d")
+            c["famx"]]), "d")
         new_c = dict(c, vis=vis, front=front, lvl=lvl,
                      fmask=fmask, n_front=n_front,
                      n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
                      ovf=jnp.bool_(False), fovf=jnp.bool_(False),
                      sovf=jnp.bool_(False), hovf=jnp.bool_(False),
+                     famx=jnp.zeros_like(c["famx"]),
                      base=jnp.int32(0), pg_off=pg_off, g_off=g_next)
         return new_c, dict(inv_ok=inv_ok, scal=scal)
 
@@ -328,7 +352,7 @@ class ShardedEngine(Engine):
 
     def _fresh_sharded_carry(self):
         D, LB, VB, FC = self.D, self.LB, self.VB, self.FC
-        one = encode(self.lay, *init_state(self.cfg))
+        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
         zeros = {k: jnp.zeros((D, LB) + v.shape, dtype=v.dtype)
                  for k, v in one.items()}
         n_inv = len(self.inv_names)
@@ -348,6 +372,7 @@ class ShardedEngine(Engine):
             sscr=jnp.zeros((D, self.SC), jnp.int32),
             n_lvl=jnp.zeros((D,), jnp.int32),
             n_gen=jnp.zeros((D,), jnp.int32),
+            famx=jnp.zeros((D, len(self.expander.families)), jnp.int32),
             base=jnp.zeros((D,), jnp.int32),
             g_off=jnp.zeros((D,), jnp.int32),
             pg_off=jnp.zeros((D,), jnp.int32),
@@ -369,11 +394,6 @@ class ShardedEngine(Engine):
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
               verbose: bool = False) -> CheckResult:
-        if (checkpoint_path or resume_from) and jax.process_count() > 1:
-            raise NotImplementedError(
-                "checkpoint/resume is single-controller only (a "
-                "multi-host checkpoint would need per-controller "
-                "shard files)")
         t0 = time.time()
         lay = self.lay
         D, W = self.D, self.W
@@ -385,13 +405,16 @@ class ShardedEngine(Engine):
             n_front = meta["n_front"]
             resumed = True
         else:
+            if seed_states is None and self.cfg.prefix_pins:
+                from ..models.golden import prefix_pin_seeds
+                seed_states = prefix_pin_seeds(self.cfg)
             init_list = (seed_states if seed_states is not None
                          else [init_state(self.cfg)])
-            init_arrs = _cat([
+            init_arrs = widen(_cat([
                 {k: np.asarray(v)[None] for k, v in s.items()}
                 if isinstance(s, dict) else
                 {k: v[None] for k, v in encode(lay, *s).items()}
-                for s in init_list])
+                for s in init_list]))
             rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
             root_fp = np.asarray(
                 self._rootfp_jit(rootsb)).astype(np.uint32)
@@ -454,8 +477,8 @@ class ShardedEngine(Engine):
             # seed carries have n_front=0 everywhere, so the level
             # program skips straight to its finalize — no separate
             # finalize-only shard_map compile
-            carry, out = self._level_jit(carry)
-            return carry, out, np.asarray(out["scal"])     # [D, 10]
+            carry, out = self._level_jit(carry, self.FAM_CAPS)
+            return carry, out, np.asarray(out["scal"])  # [D, 10+n_fams]
 
         def grow_table_if_needed(carry):
             # pessimistic per-shard load bound, checked between levels
@@ -542,7 +565,7 @@ class ShardedEngine(Engine):
             depth += 1
             carry = grow_table_if_needed(carry)
             while True:
-                carry, out = self._level_jit(carry)
+                carry, out = self._level_jit(carry, self.FAM_CAPS)
                 scal = np.asarray(out["scal"])
                 ovf = bool(scal[:, 4].any())
                 fovf = bool(scal[:, 5].any())
@@ -550,10 +573,20 @@ class ShardedEngine(Engine):
                 hovf = bool(scal[:, 9].any())
                 if not (ovf or fovf or sovf or hovf):
                     break
-                old_caps = (self.LB, self.FC, self.SC)
+                old_caps = (self.LB, self.FC, self.SC, self.FAM_CAPS)
                 if fovf:
-                    self.FC *= 4
-                if sovf or fovf:
+                    famx = scal[:, 10:10 + len(self.FAM_CAPS)].max(axis=0)
+                    caps = list(self.FAM_CAPS)
+                    fam_over = False
+                    for fi, fam in enumerate(self.expander.families):
+                        hard = fam.n_lanes * self.BL
+                        while caps[fi] < hard and famx[fi] > caps[fi]:
+                            caps[fi] = min(2 * caps[fi], hard)
+                            fam_over = True
+                    self.FAM_CAPS = tuple(caps)
+                    if not fam_over:
+                        self.FC *= 4
+                if sovf or (fovf and self.FC != old_caps[1]):
                     self.SC = max(4 * self.SC, 4 * self.FC // self.D)
                 if ovf or self.LB < max(4 * self.FC,
                                         2 * self.D * self.SC):
@@ -568,7 +601,7 @@ class ShardedEngine(Engine):
                           f"(ovf={ovf} fovf={fovf} sovf={sovf} "
                           f"hovf={hovf}), LB={self.LB} FC={self.FC} "
                           f"SC={self.SC} VB={self.VB}")
-                if (self.LB, self.FC, self.SC) != old_caps:
+                if (self.LB, self.FC, self.SC) != old_caps[:3]:
                     carry = self._grow_sharded(carry)
                     # the replayed level can add up to the NEW LB keys
                     # per shard: re-check the table load bound
@@ -628,26 +661,42 @@ class ShardedEngine(Engine):
         return new
 
     # ------------------------------------------------------------------
-    # checkpoint / resume (sharded layout; single-controller only — the
-    # check() entry guards multi-host).  Same wavefront semantics as
+    # checkpoint / resume (sharded layout; single-controller — the
+    # _save_checkpoint entry fails fast under multiple controllers;
+    # MultiHostEngine overrides both methods with per-controller shard
+    # files).  Same wavefront semantics as
     # engine/bfs: written at level boundaries, resume lands on
     # bit-identical counts.
     # ------------------------------------------------------------------
 
     def _save_checkpoint(self, path, carry, res, depth, n_states,
                          n_vis, n_front):
+        if jax.process_count() > 1:
+            # fail fast, not hours in: this serializer np.asarray's the
+            # whole carry, which a multi-controller run cannot address
+            raise NotImplementedError(
+                "ShardedEngine checkpoints are single-controller; use "
+                "MultiHostEngine (per-controller shard files) for "
+                "multi-process runs")
         ckpt_write(path, carry, self.store_states, self._parents,
                    self._lanes, self._states, res, dict(
                        sharded=True, D=self.D, chunk=self.chunk,
                        LB=self.LB, VB=self.VB, FC=self.FC, SC=self.SC,
+                       fam_caps=list(self.FAM_CAPS),
                        depth=depth, n_states=n_states,
                        n_vis=[int(x) for x in n_vis],
                        n_front=int(n_front), cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
         from ..engine.bfs import CheckpointError
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "ShardedEngine checkpoints are single-controller; use "
+                "MultiHostEngine (per-controller shard files) for "
+                "multi-process runs")
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
-                            ("D", "LB", "VB", "FC", "SC"), sharded=True)
+                            ("D", "LB", "VB", "FC", "SC", "fam_caps"),
+                            sharded=True)
         if meta["D"] != self.D:
             raise CheckpointError(
                 f"checkpoint was written on a {meta['D']}-device mesh; "
@@ -655,11 +704,14 @@ class ShardedEngine(Engine):
                 "mesh-size dependent)")
         self.LB, self.VB, self.FC, self.SC = (
             meta["LB"], meta["VB"], meta["FC"], meta["SC"])
+        self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])
         template = jax.eval_shape(lambda: self._fresh_sharded_carry())
         carry = ckpt_carry(path, z, template, self._to_device)
         self._parents, self._lanes, self._states = ckpt_archives(
             z, meta, template, self.store_states)
-        return carry, ckpt_result(z, meta), meta
+        res = ckpt_result(z, meta)
+        z.close()             # all arrays extracted; don't leak the fd
+        return carry, res, meta
 
     def _rehash_sharded(self, carry):
         """Per-shard device rehash into self.VB-slot tables (sharded

@@ -8,11 +8,22 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import ModelConfig
+from ..engine.bfs import CheckpointError, ckpt_carry, ckpt_read, \
+    ckpt_result, ckpt_write
 from .mesh import ShardedEngine
 
 
 class MultiHostEngine(ShardedEngine):
-    """ShardedEngine whose mesh spans every process's devices."""
+    """ShardedEngine whose mesh spans every process's devices.
+
+    Checkpoints are per-controller shard files (``<path>.proc<k>``):
+    each controller writes only its addressable device rows, and resume
+    rebuilds the global arrays with every controller serving its own
+    rows (TLC's distributed mode checkpoints analogously — worker-local
+    state files).  Mid-run capacity growth works too: the growth
+    decision comes from the replicated scal matrix, so every controller
+    re-homes its shards into identically-shaped new global arrays in
+    lockstep."""
 
     def __init__(self, cfg: ModelConfig, chunk: int = 512,
                  store_states: bool = False, **kw):
@@ -24,14 +35,6 @@ class MultiHostEngine(ShardedEngine):
         kw.pop("devices", None)
         super().__init__(cfg, devices=jax.devices(), chunk=chunk,
                          store_states=False, **kw)
-
-    def check(self, *args, **kw):
-        if kw.get("checkpoint_path") or kw.get("resume_from"):
-            raise NotImplementedError(
-                "checkpoint/resume is not supported by MultiHostEngine "
-                "(a multi-host checkpoint would need per-controller "
-                "shard files); use ShardedEngine on one controller")
-        return super().check(*args, **kw)
 
     # -- global-array plumbing -----------------------------------------
 
@@ -54,8 +57,87 @@ class MultiHostEngine(ShardedEngine):
     def _fresh_sharded_carry(self):
         return self._to_device(self._fresh_sharded_carry_host())
 
-    def _grow_sharded(self, carry):
-        raise RuntimeError(
-            "buffer overflow in a multi-host run: pre-size "
-            "lcap/vcap/fcap/scap (mid-run growth would rebuild global "
-            "arrays, which is not supported across controllers)")
+    # _grow_sharded: the base implementation is global-array-safe (the
+    # concats/zeros run as SPMD ops on P("d") arrays and every
+    # controller takes the identical growth branch from the replicated
+    # scal matrix), so mid-run growth needs no multi-host override.
+
+    # -- per-controller checkpoint shards ------------------------------
+
+    def _proc_path(self, path):
+        return f"{path}.proc{jax.process_index()}"
+
+    def _local_block(self, leaf):
+        """Addressable [d, ...] rows of a P('d') global array as
+        (device_indices, stacked numpy block)."""
+        rows = []
+        for s in leaf.addressable_shards:
+            ix = s.index[0]
+            d = (ix.start or 0) if isinstance(ix, slice) else ix
+            rows.append((int(d), np.asarray(s.data)[0]))
+        rows.sort(key=lambda t: t[0])
+        return [d for d, _ in rows], np.stack([r for _, r in rows])
+
+    def _save_checkpoint(self, path, carry, res, depth, n_states,
+                         n_vis, n_front):
+        d_idx = None
+        blocks = []
+        for _kp, leaf in jax.tree_util.tree_flatten_with_path(carry)[0]:
+            ds, blk = self._local_block(leaf)
+            d_idx = ds
+            blocks.append(blk)
+        # a carry-shaped pytree of the local blocks keeps ckpt leaf
+        # names in lockstep with the fresh-carry template at load time
+        carry_local = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(carry), blocks)
+        ckpt_write(self._proc_path(path), carry_local, False, [], [],
+                   [], res, dict(
+                       sharded=True, multihost=True,
+                       D=self.D, n_proc=jax.process_count(),
+                       proc=jax.process_index(), d_idx=d_idx,
+                       chunk=self.chunk, LB=self.LB, VB=self.VB,
+                       FC=self.FC, SC=self.SC,
+                       fam_caps=list(self.FAM_CAPS),
+                       depth=depth, n_states=n_states,
+                       n_vis=[int(x) for x in n_vis],
+                       n_front=int(n_front), cfg=repr(self.cfg)))
+
+    def _load_checkpoint(self, path):
+        z, meta = ckpt_read(self._proc_path(path), repr(self.cfg),
+                            self.chunk,
+                            ("D", "n_proc", "proc", "d_idx", "LB", "VB",
+                             "FC", "SC", "fam_caps"), sharded=True)
+        if meta["n_proc"] != jax.process_count() or \
+                meta["D"] != self.D:
+            raise CheckpointError(
+                f"checkpoint was written by {meta['n_proc']} "
+                f"controllers x {meta['D']} devices; this run has "
+                f"{jax.process_count()} controllers x {self.D}")
+        if meta["proc"] != jax.process_index():
+            raise CheckpointError(
+                f"{self._proc_path(path)} belongs to controller "
+                f"{meta['proc']}")
+        self.LB, self.VB, self.FC, self.SC = (
+            meta["LB"], meta["VB"], meta["FC"], meta["SC"])
+        self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])
+        d_of = {int(d): r for r, d in enumerate(meta["d_idx"])}
+        template = jax.eval_shape(
+            lambda: ShardedEngine._fresh_sharded_carry(self))
+
+        def to_global(block):
+            # each controller serves only its own device rows; the
+            # callback is never invoked for non-addressable shards
+            sharding = NamedSharding(self.mesh, P("d"))
+            shape = (self.D,) + block.shape[1:]
+
+            def cb(idx, block=block):
+                ix = idx[0]
+                d = (ix.start or 0) if isinstance(ix, slice) else ix
+                return block[d_of[int(d)]][None]
+            return jax.make_array_from_callback(shape, sharding, cb)
+
+        carry = ckpt_carry(self._proc_path(path), z, template, to_global)
+        self._parents, self._lanes, self._states = [], [], []
+        res = ckpt_result(z, meta)
+        z.close()
+        return carry, res, meta

@@ -1,0 +1,46 @@
+"""Shared host-side helpers used across the engines.
+
+Device-side math lives in ops/ and engine/fingerprint; these are the
+small numpy/python twins the BFS drivers share (engine/bfs re-exports
+them under its historical names for backward compatibility).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def fmix32_int(x: int) -> int:
+    """Host twin of engine.fingerprint.fmix32 (murmur3 finalizer) on
+    plain ints — used for host-side probe placement of root/seed keys."""
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+def cat_arrays(chunks: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """Concatenate a list of SoA dicts along the batch axis."""
+    return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
+
+
+def take_arrays(arrs: Dict[str, np.ndarray], idx) -> Dict[str, np.ndarray]:
+    """Row-select every array of an SoA dict."""
+    return {k: v[idx] for k, v in arrs.items()}
+
+
+def fp_key(fp_u32: np.ndarray) -> np.ndarray:
+    """[N, n_streams] u32 -> 1-D sortable dedup key covering ALL streams:
+    plain u64 for the 2-stream default, a lexicographic structured array
+    for fp128 (so the extra streams actually buy collision resistance)."""
+    fp = np.asarray(fp_u32, dtype=np.uint64)
+    u64 = (fp[:, 0::2] << np.uint64(32)) | fp[:, 1::2]
+    if u64.shape[1] == 1:
+        return u64[:, 0]
+    dtype = np.dtype([(f"w{i}", "<u8") for i in range(u64.shape[1])])
+    return np.ascontiguousarray(u64).view(dtype)[:, 0]

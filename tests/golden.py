@@ -1,72 +1,11 @@
-"""Golden witness traces — the reference's punctuated-search fixtures.
+"""Golden witness traces — re-exported from the package.
 
-The reference embeds two hard-coded witness traces as search-prefix pins
-(tlc_membership/raft.tla):
-
-  * ConcurrentLeaders witness, 20 history records, inside
-    ``CommitWhenConcurrentLeaders_unique`` (raft.tla:1198-1204)
-  * CommitWhenConcurrentLeaders witness, 28 history records, inside
-    ``MajorityOfClusterRestarts_constraint`` (raft.tla:1228-1234)
-
-Here they are re-expressed as oracle successor-label sequences (with the
-reference's s1,s2,s3 mapped to server ids 0,1,2).  History records are
-emitted by Send/Discard/Reply and the named actions — one top-level step
-can emit 0, 1 or 2 records (e.g. ``UpdateTerm`` consumes nothing and logs
-nothing, raft.tla:826-832; a Reply logs Receive + Send, raft.tla:308-314)
-— so 18 labels produce the 20-record trace and 9 more labels produce
-records 21-28.
+The label sequences moved to ``raft_tla_tpu.models.golden`` when the
+cfg-level prefix pins (``CommitWhenConcurrentLeaders_unique`` /
+``MajorityOfClusterRestarts_constraint``, raft.tla:1198-1234) started
+compiling into engine seeds; tests import them from here unchanged.
 """
 
-# --- records 1-20: two elections ending with concurrent leaders --------
-# r2/r3: s1 sends RVReq to s2 first, then to itself (golden record order).
-# r8/r9 and r18/r19: the remote vote response is received before the
-# self-response.
-CONCURRENT_LEADERS_LABELS = [
-    "Timeout(0)",           # r1
-    "RequestVote(0,1)",     # r2   Send RVReq 0->1
-    "RequestVote(0,0)",     # r3   Send RVReq 0->0
-    "HandleRVReq(0<-0)",    # r4,r5   Receive + Send RVResp (self grant)
-    "UpdateTerm(1)",        # (no record; non-consuming, raft.tla:831)
-    "HandleRVReq(1<-0)",    # r6,r7
-    "HandleRVResp(0<-1)",   # r8
-    "HandleRVResp(0<-0)",   # r9
-    "BecomeLeader(0)",      # r10  leaders={0}
-    "Timeout(1)",           # r11
-    "RequestVote(1,1)",     # r12  Send RVReq 1->1 (self first, golden)
-    "RequestVote(1,2)",     # r13
-    "HandleRVReq(1<-1)",    # r14,r15
-    "UpdateTerm(2)",        # (no record)
-    "HandleRVReq(2<-1)",    # r16,r17
-    "HandleRVResp(1<-2)",   # r18
-    "HandleRVResp(1<-1)",   # r19
-    "BecomeLeader(1)",      # r20  leaders={0,1}
-]
-
-# --- records 21-28: both leaders replicate; commit under 2 leaders -----
-# ClientRequest bumps hadNumClientRequests but logs no record
-# (raft.tla:488-497); AENoConflict appends without reply or record
-# (raft.tla:668-672) — the success reply comes from the *second* receive
-# of the same request (AlreadyDone, raft.tla:639-655).
-CWCL_EXTENSION_LABELS = [
-    "ClientRequest(0,1)",       # log[0] = [(2, Value, 1)]
-    "AppendEntries(0,1)",       # r21  Send AEReq 0->1 (entry term 2)
-    "ClientRequest(1,2)",       # log[1] = [(3, Value, 2)]
-    "AppendEntries(1,2)",       # r22  Send AEReq 1->2 (entry term 3)
-    "AENoConflict(2)",          # (no record) s2 appends the entry
-    "AEAlreadyDone(2)",         # r23,r24  Receive + Send success reply
-    "HandleAEResp(1<-2)",       # r25  matchIndex[1][2] := 1
-    "AdvanceCommitIndex(1)",    # r26  CommitEntry (term 3, value 2)
-    "RejectAEReq(1)",           # r27,r28  stale-term AEReq from s1
-]
-
-GOLDEN_20_KINDS = [
-    "Timeout", "Send", "Send", "Receive", "Send", "Receive", "Send",
-    "Receive", "Receive", "BecomeLeader",
-    "Timeout", "Send", "Send", "Receive", "Send", "Receive", "Send",
-    "Receive", "Receive", "BecomeLeader",
-]
-
-GOLDEN_28_KINDS = GOLDEN_20_KINDS + [
-    "Send", "Send", "Receive", "Send", "Receive", "CommitEntry",
-    "Receive", "Send",
-]
+from raft_tla_tpu.models.golden import (  # noqa: F401
+    CONCURRENT_LEADERS_LABELS, CWCL_EXTENSION_LABELS, GOLDEN_20_KINDS,
+    GOLDEN_28_KINDS)

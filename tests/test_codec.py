@@ -143,6 +143,37 @@ def test_membership_feature_lanes_match_oracle_predicates():
     assert seen_added
 
 
+def test_narrow_widen_roundtrip_and_fp_parity():
+    """Engines store rows in codec.narrow_dtypes; narrowing must be
+    lossless under the configured bounds and the fingerprint must be
+    bit-identical on narrow and wide rows (the sharded engine
+    fingerprints wide rows but ships narrow rows over the ICI)."""
+    import jax
+    import numpy as np
+    from raft_tla_tpu.engine.fingerprint import Fingerprinter
+    from raft_tla_tpu.ops.codec import narrow, widen, stack
+
+    import jax.numpy as jnp
+
+    cfg = MEMBER.with_(symmetry=True)
+    lay = Layout(cfg)
+    arrs = stack([encode(lay, s, h)
+                  for (s, h) in reachable_states(cfg, 250)[:200]])
+    nar = narrow(lay, arrs)
+    assert nar["ct"].dtype == np.int8
+    assert nar["log"].dtype in (np.int8, np.int16)
+    assert nar["bag"].dtype == np.uint32 and nar["ctr"].dtype == np.int32
+    wide = widen(nar)
+    for k in arrs:
+        assert (np.asarray(wide[k]) == arrs[k]).all(), k
+    fpr = Fingerprinter(cfg)
+    fp_w = np.asarray(jax.jit(fpr.fingerprint_batch)(
+        {k: jnp.asarray(v) for k, v in arrs.items()}))
+    fp_n = np.asarray(jax.jit(fpr.fingerprint_batch)(
+        {k: jnp.asarray(v) for k, v in nar.items()}))
+    assert (fp_w == fp_n).all()
+
+
 def test_fingerprint_batch_matches_per_state():
     """The batch-minor fingerprint formulation (the engine's hot path)
     is bit-identical to the per-state reference formulation, for both

@@ -35,23 +35,7 @@ def _free_port():
 @pytest.mark.slow
 def test_two_controllers_match_oracle():
     want = explore(MICRO)
-    port = _free_port()
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("JAX_COMPILATION_CACHE_DIR", None)
-    procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(pid), "2", str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env, cwd=REPO) for pid in range(2)]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        line = [ln for ln in out.splitlines()
-                if ln.startswith("RESULT ")]
-        assert line, f"no RESULT line:\n{out}\n{err}"
-        outs.append(json.loads(line[-1][len("RESULT "):]))
+    outs = _run_pair({})
     for r in outs:
         assert r["n_devices"] == 4          # 2 procs x 2 devices
         assert r["distinct"] == want.distinct_states
@@ -60,3 +44,58 @@ def test_two_controllers_match_oracle():
         assert r["violations"] == 0
     # both controllers report identical global results
     assert outs[0] == dict(outs[1], pid=0)
+
+
+def _run_pair(opts):
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(pid), "2", str(port),
+         json.dumps(opts)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO) for pid in range(2)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert line, f"no RESULT line:\n{out}\n{err}"
+        outs.append(json.loads(line[-1][len("RESULT "):]))
+    return outs
+
+
+@pytest.mark.slow
+def test_multihost_checkpoint_resume(tmp_path):
+    """Kill a 2-controller run at depth 6, resume from the
+    per-controller checkpoint shards (<path>.proc<k>), land on the
+    exact counts of an uninterrupted run (VERDICT r2 item 8)."""
+    want = explore(MICRO)
+    ckpt = str(tmp_path / "mh.ckpt")
+    part = _run_pair({"checkpoint": ckpt, "max_depth": 6})
+    assert all(r["distinct"] < want.distinct_states for r in part)
+    assert os.path.exists(ckpt + ".proc0")
+    assert os.path.exists(ckpt + ".proc1")
+    full = _run_pair({"resume": ckpt})
+    for r in full:
+        assert r["distinct"] == want.distinct_states
+        assert r["depth"] == want.depth
+        assert r["generated"] == want.generated_states
+
+
+@pytest.mark.slow
+def test_multihost_midrun_growth():
+    """Tiny send/level caps force mid-run capacity growth; every
+    controller takes the identical growth branch (replicated scal) and
+    the re-homed global arrays still land on the oracle's counts
+    (VERDICT r2 item 8: lifted NotImplementedError)."""
+    want = explore(MICRO)
+    outs = _run_pair({"scap": 2, "lcap": 64, "vcap": 1 << 12})
+    for r in outs:
+        assert r["distinct"] == want.distinct_states
+        assert r["depth"] == want.depth
+        assert r["generated"] == want.generated_states
+    # growth actually happened (caps above their floors)
+    assert all(r["final_caps"][1] > 2 for r in outs)

@@ -1,9 +1,15 @@
 """Worker process for tests/test_multihost.py: one controller in a
 multi-controller CPU run (gloo collectives = the DCN stand-in).
 
-Usage: python tools/multihost_worker.py <pid> <nproc> <port>
+Usage: python tools/multihost_worker.py <pid> <nproc> <port> [opts-json]
+opts (all optional): {"checkpoint": path, "resume": path,
+                      "max_depth": int, "lcap": int, "vcap": int,
+                      "scap": int, "chunk_mult": int}
 Caller must set XLA_FLAGS=--xla_force_host_platform_device_count=N and
 JAX_PLATFORMS=cpu in the environment BEFORE the interpreter starts.
+Tiny lcap/scap force mid-run capacity growth — exercised by the growth
+test (every controller takes the identical growth branch from the
+replicated scal matrix).
 """
 import json
 import os
@@ -13,6 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+opts = json.loads(sys.argv[4]) if len(sys.argv) > 4 else {}
 
 import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
@@ -34,11 +41,17 @@ cfg = ModelConfig(
                        max_client_requests=1))
 
 D = len(jax.devices())
-eng = MultiHostEngine(cfg, chunk=4 * D, lcap=1 << 12, vcap=1 << 15)
-r = eng.check()
+eng = MultiHostEngine(cfg, chunk=opts.get("chunk_mult", 4) * D,
+                      lcap=opts.get("lcap", 1 << 12),
+                      vcap=opts.get("vcap", 1 << 15),
+                      scap=opts.get("scap"))
+r = eng.check(max_depth=opts.get("max_depth", 10 ** 9),
+              checkpoint_path=opts.get("checkpoint"),
+              resume_from=opts.get("resume"))
 print("RESULT " + json.dumps(dict(
     pid=pid, n_devices=D,
     distinct=int(r.distinct_states), depth=int(r.depth),
     generated=int(r.generated_states),
-    violations=int(r.violations_global))),
+    violations=int(r.violations_global),
+    final_caps=[int(eng.LB), int(eng.SC), int(eng.FC)])),
     flush=True)

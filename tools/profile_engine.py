@@ -27,7 +27,7 @@ print(f"lanes={eng.A} chunk={chunk} N={chunk*eng.A} lcap={lcap} vcap={vcap}")
 
 # --- compile timings -------------------------------------------------
 carry = eng._fresh_carry(eng.LCAP, eng.VCAP)
-t0 = time.time(); c2 = eng._step_jit(carry)
+t0 = time.time(); c2 = eng._step_jit(carry, eng.FAM_CAPS)
 jax.block_until_ready(c2["n_lvl"]); print(f"step compile+run1: {time.time()-t0:.1f}s")
 t0 = time.time(); c3, out = eng._fin_jit(c2)
 jax.block_until_ready(out["scal"]); print(f"finalize compile+run1: {time.time()-t0:.1f}s")
@@ -37,7 +37,7 @@ jax.block_until_ready(out["scal"]); print(f"finalize compile+run1: {time.time()-
 import numpy as _np
 t0 = time.time()
 for _ in range(10):
-    c3 = eng._step_jit(c3)
+    c3 = eng._step_jit(c3, eng.FAM_CAPS)
 _ = int(_np.asarray(c3["n_lvl"]))
 dt = (time.time()-t0)/10
 print(f"steady chunk step: {dt*1000:.1f} ms -> {chunk/dt:.0f} parent-states/s "
