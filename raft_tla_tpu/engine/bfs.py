@@ -63,7 +63,7 @@ from ..utils import fmix32_int as _fmix32_int
 from ..utils import fp_key
 from ..utils import take_arrays as _take
 from .expand import Expander
-from .fingerprint import Fingerprinter, combine_u64, fmix32
+from .fingerprint import Fingerprinter, fmix32
 
 U32MAX = jnp.uint32(0xFFFFFFFF)
 
@@ -331,6 +331,12 @@ class Engine:
         self._rehash_cache = {}
         self._phase1 = jax.jit(self._phase1_impl)
         self._phase2 = jax.jit(self._phase2_impl)
+        # NOTE: a multi-chunk dispatch (K chunk steps per device call
+        # via fori_loop) was tried and MEASURED SLOWER on v5e (70k ->
+        # 38k states/s at K=4): XLA copies the loop-carried level/table
+        # buffers at the loop boundary instead of aliasing them, which
+        # outweighs the ~10ms flat dispatch cost of the tunneled
+        # runtime that motivated it.
         self._step_jit = jax.jit(self._chunk_step_impl, donate_argnums=0,
                                  static_argnums=1)
         self._fin_jit = jax.jit(self._finalize_impl, donate_argnums=0)
@@ -371,21 +377,27 @@ class Engine:
         fp, act = jax.vmap(per_state)(svb, cand, ok)
         return ok & act, cand, fp
 
+    def _phase2_one(self, sv):
+        der = self.kern.derived(sv)
+        inv = jnp.stack([self.preds.invariant_fn(nm)(sv, der)
+                         for nm in self.inv_names]) \
+            if self.inv_names else jnp.ones((0,), bool)
+        con = jnp.bool_(True)
+        for nm in self.con_names:
+            con = con & self.preds.constraint_fn(nm)(sv, der)
+        return inv, con
+
     def _phase2_impl(self, svb):
-        def one(sv):
-            der = self.kern.derived(sv)
-            inv = jnp.stack([self.preds.invariant_fn(nm)(sv, der)
-                             for nm in self.inv_names]) \
-                if self.inv_names else jnp.ones((0,), bool)
-            con = jnp.bool_(True)
-            for nm in self.con_names:
-                con = con & self.preds.constraint_fn(nm)(sv, der)
-            return inv, con
-        # batch-minor (rows vmapped at -1): the tiny per-state minor
-        # dims waste TPU vector tiles batch-major (expand.materialize)
-        svT = {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()}
-        inv, con = jax.vmap(one, in_axes=-1, out_axes=-1)(svT)
+        """Batch-major ([B, ...]) public API: inv [B, n_inv], con [B]."""
+        inv, con = self._phase2_T(
+            {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()})
         return jnp.moveaxis(inv, -1, 0), con
+
+    def _phase2_T(self, svT):
+        """Batch-LAST hot-path twin: inv [n_inv, B], con [B] (rows
+        vmapped at -1 — the tiny per-state minor dims waste TPU vector
+        tiles batch-major, expand.materialize docstring)."""
+        return jax.vmap(self._phase2_one, in_axes=-1, out_axes=-1)(svT)
 
     # ------------------------------------------------------------------
     # device-resident dedup primitives
@@ -586,16 +598,21 @@ class Engine:
         base = carry["base"]        # device-resident chunk cursor: a
         # host-passed scalar would cost a blocking ~100ms host->device
         # transfer per chunk through the tunneled-TPU runtime
-        # frontier rows are stored narrow (codec.narrow_dtypes); widen
-        # the chunk to the kernels' int32 contract
-        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B)
+        # Frontier rows are stored narrow (codec.narrow_dtypes) and
+        # BATCH-LAST ([..., LCAP]): the tiny per-state dims (S, Lcap,
+        # K) stay off the TPU's 128-lane axis, and the loop-carried
+        # buffers tile without padding blowups — which is what lets
+        # _chunk_steps_k run several chunks per dispatch (a dispatch
+        # through the tunneled runtime costs ~10ms flat).
+        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B,
+                                                axis=v.ndim - 1)
                     for k, v in carry["front"].items()})
         fmask = lax.dynamic_slice_in_dim(carry["fmask"], base, B)
         # guard-first expansion: guards over the whole lane grid (the
         # successor construction is DCE'd), successors materialized only
         # for enabled lanes (expand.Expander.materialize)
-        derb = self.expander.derived_batch(sv)
-        ok = lax.optimization_barrier(self.expander.guards(sv, derb))
+        derb = self.expander.derived_batch_T(sv)
+        ok = lax.optimization_barrier(self.expander.guards_T(sv, derb))
         # fmask carries both the live-row bound and the CONSTRAINT
         # prune-not-expand mask (SURVEY §2.8)
         valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
@@ -613,7 +630,7 @@ class Engine:
                 idx, mode="drop"))                       # slot -> lane
         cand_c, famx = self.expander.materialize(
             sv, derb, okf, epos, FCAP, fam_caps)
-        cand_c = lax.optimization_barrier(cand_c)        # [FCAP, …]
+        cand_c = lax.optimization_barrier(cand_c)        # [..., FCAP]
         famx = jnp.maximum(carry["famx"], famx)
         fovf = carry["fovf"] | (n_e > FCAP) | \
             jnp.any(famx > jnp.asarray(fam_caps, jnp.int32))
@@ -622,15 +639,15 @@ class Engine:
         if self.act_names:
             # ACTION_CONSTRAINTS on the compacted (parent, successor)
             # pairs: violating transitions are killed before dedup
-            par_c = {k: v[take // A] for k, v in sv.items()}
-            act = jax.vmap(self._act_ok)(par_c, cand_c)
+            par_c = {k: v[..., take // A] for k, v in sv.items()}
+            act = jax.vmap(self._act_ok, in_axes=-1)(par_c, cand_c)
             elive = elive & act
         n_gen = carry["n_gen"] + elive.sum(dtype=jnp.int32)
 
         # fingerprint only the compacted candidates
         fp = lax.optimization_barrier(
-            self.fpr.fingerprint_batch(cand_c))          # [FCAP, W]
-        keys = tuple(jnp.where(elive, fp[:, w], U32MAX)
+            self.fpr.fingerprint_batch_T(cand_c))        # [W, FCAP]
+        keys = tuple(jnp.where(elive, fp[w], U32MAX)
                      for w in range(W))
         # any overflow means this level replays — stop inserting so the
         # journal stays the exact record of this level's table writes
@@ -667,12 +684,13 @@ class Engine:
         start = jnp.minimum(carry["n_lvl"], LCAP - FCAP)
         lane = take[lidx]                                # original lane id
         rows = lax.optimization_barrier(
-            {k: cand_c[k][lidx] for k in cand_c})
+            {k: cand_c[k][..., lidx] for k in cand_c})   # batch-last
         # invariants + constraints on the fresh rows (garbage rows are
         # masked by n_lvl at finalize)
-        inv, con = lax.optimization_barrier(self._phase2_impl(rows))
+        inv, con = lax.optimization_barrier(self._phase2_T(rows))
         rows_n = narrow(self.lay, rows)        # storage dtypes for lvl
-        lvl = {k: lax.dynamic_update_slice_in_dim(v, rows_n[k], start, 0)
+        lvl = {k: lax.dynamic_update_slice_in_dim(
+                   v, rows_n[k], start, v.ndim - 1)
                for k, v in carry["lvl"].items()}
         # parent global ids are arithmetic: frontier row r has id
         # pg_off + r (the frontier IS the previous level, uncompacted)
@@ -682,7 +700,8 @@ class Engine:
             carry["llane"], lane % A, start, 0)
         jslot = lax.dynamic_update_slice_in_dim(
             carry["jslot"], pos[lidx], start, 0)
-        linv = lax.dynamic_update_slice(carry["linv"], inv, (start, 0))
+        linv = lax.dynamic_update_slice_in_dim(carry["linv"], inv,
+                                               start, 1)
         lcon = lax.dynamic_update_slice_in_dim(
             carry["lcon"], con, start, 0)
         return dict(carry, vis=table, claims=claims, lvl=lvl, lpar=lpar,
@@ -724,11 +743,11 @@ class Engine:
         g_off = carry["g_off"]
         bad = carry["ovf"] | carry["fovf"] | carry["hovf"]
         validrow = jnp.arange(LCAP, dtype=jnp.int32) < n_lvl
-        inv_ok = (carry["linv"] | ~validrow[:, None]
-                  if self.inv_names else carry["linv"])
+        inv_ok = (carry["linv"] | ~validrow[None, :]
+                  if self.inv_names else carry["linv"])   # [n_inv, LCAP]
         con = carry["lcon"]
         n_viol = (~inv_ok).sum(dtype=jnp.int32)
-        faults = ((carry["lvl"]["ctr"][:, C_OVERFLOW] > 0) &
+        faults = ((carry["lvl"]["ctr"][C_OVERFLOW] > 0) &
                   validrow).sum(dtype=jnp.int32)
 
         def commit(carry):
@@ -776,7 +795,9 @@ class Engine:
     def _fresh_carry(self, lcap: int, vcap: int, fcap: Optional[int] = None):
         fcap = fcap if fcap is not None else self.FCAP
         one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
-        zeros = {k: jnp.zeros((lcap,) + v.shape, dtype=v.dtype)
+        # frontier/level state buffers are BATCH-LAST ([..., lcap]) —
+        # see the chunk step's layout note
+        zeros = {k: jnp.zeros(v.shape + (lcap,), dtype=v.dtype)
                  for k, v in one.items()}
         n_inv = len(self.inv_names)
         return dict(
@@ -784,7 +805,7 @@ class Engine:
             vis=tuple(jnp.full((vcap,), U32MAX) for _ in range(self.W)),
             claims=jnp.full((vcap,), U32MAX),
             jslot=jnp.full((lcap,), -1, jnp.int32),  # level insert journal
-            linv=jnp.ones((lcap, n_inv), bool),      # per-row invariants
+            linv=jnp.ones((n_inv, lcap), bool),      # per-row invariants
             lcon=jnp.ones((lcap,), bool),            # per-row constraints
             lvl=zeros,
             lpar=jnp.full((lcap,), -1, jnp.int32),
@@ -817,7 +838,8 @@ class Engine:
         new["claims"] = carry["claims"]
         pad = lcap - old_lcap
         new["front"] = {k: jnp.concatenate(
-            [carry["front"][k], jnp.zeros((pad,) + v.shape[1:], v.dtype)])
+            [carry["front"][k],
+             jnp.zeros(v.shape[:-1] + (pad,), v.dtype)], axis=-1)
             for k, v in carry["front"].items()}
         new["fmask"] = jnp.concatenate(
             [carry["fmask"], jnp.zeros((pad,), bool)])
@@ -893,10 +915,11 @@ class Engine:
             # probe placement — the table is empty, so the sequential
             # simulation is exact) and finalize.
             pad = self.LCAP - n_roots
-            roots_n = narrow(self.lay, widen(roots))   # storage dtypes
+            roots_n = {k: np.moveaxis(v, 0, -1) for k, v in
+                       narrow(self.lay, widen(roots)).items()}
             carry["lvl"] = {k: jnp.asarray(np.concatenate(
-                [roots_n[k], np.zeros((pad,) + roots_n[k].shape[1:],
-                                      roots_n[k].dtype)]))
+                [roots_n[k], np.zeros(roots_n[k].shape[:-1] + (pad,),
+                                      roots_n[k].dtype)], axis=-1))
                 for k in roots_n}
             rk = np.asarray(root_fp[first_idx], dtype=np.uint32)
             slots = self._host_probe_assign(rk)
@@ -912,8 +935,8 @@ class Engine:
             # theirs inside the chunk step; roots bypass it)
             inv_r, con_r = self._phase2(
                 {k: jnp.asarray(roots[k]) for k in roots})
-            linv = np.ones((self.LCAP, len(self.inv_names)), bool)
-            linv[:n_roots] = np.asarray(inv_r)
+            linv = np.ones((len(self.inv_names), self.LCAP), bool)
+            linv[:, :n_roots] = np.asarray(inv_r).T
             lcon = np.ones((self.LCAP,), bool)
             lcon[:n_roots] = np.asarray(con_r)
             carry["linv"] = jnp.asarray(linv)
@@ -954,18 +977,20 @@ class Engine:
             if self.store_states:
                 # after finalize the level's rows live in front (the
                 # buffers swap); they are only overwritten by the
-                # next-next level's chunk steps
+                # next-next level's chunk steps.  Archives are stored
+                # batch-major numpy (host layout) — decode/trace/_take
+                # row-index them.
                 self._parents.append(np.asarray(carry["lpar"][:n_lvl]))
                 self._lanes.append(np.asarray(carry["llane"][:n_lvl]))
                 self._states.append(
-                    {k: np.asarray(v[:n_lvl])
+                    {k: np.moveaxis(np.asarray(v[..., :n_lvl]), -1, 0)
                      for k, v in carry["front"].items()})
             if n_viol:
-                inv_ok = np.asarray(out["inv_ok"])[:n_lvl]
-                rows = {k: np.asarray(v)[:n_lvl]
+                inv_ok = np.asarray(out["inv_ok"])[:, :n_lvl]
+                rows = {k: np.moveaxis(np.asarray(v[..., :n_lvl]), -1, 0)
                         for k, v in carry["front"].items()}
                 for j, nm in enumerate(self.inv_names):
-                    for s in np.nonzero(~inv_ok[:, j])[0]:
+                    for s in np.nonzero(~inv_ok[j])[0]:
                         vsv, vh = decode(self.lay, _take(rows, s))
                         res.violations.append(
                             Violation(nm, n_states + int(s),
@@ -1083,12 +1108,12 @@ class Engine:
                        depth=depth, n_states=n_states, n_vis=n_vis,
                        n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
                        FCAP=self.FCAP, fam_caps=list(self.FAM_CAPS),
-                       chunk=self.chunk, cfg=repr(self.cfg)))
+                       layout=2, chunk=self.chunk, cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
-                            ("LCAP", "VCAP", "FCAP", "fam_caps"),
-                            sharded=False)
+                            ("LCAP", "VCAP", "FCAP", "fam_caps",
+                             "layout"), sharded=False)
         self.LCAP, self.VCAP, self.FCAP = (meta["LCAP"], meta["VCAP"],
                                            meta["FCAP"])
         self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])

@@ -180,41 +180,45 @@ class Expander:
             chunk * min(f.n_lanes, _FAMILY_DENSITY.get(f.name, 2))
             for f in self.families)
 
-    def derived_batch(self, svb):
-        return jax.vmap(self.kern.derived)(svb)
+    def derived_batch_T(self, svT):
+        """Batch-LAST derived quantities (the engines' batch-minor hot
+        path — see materialize's layout note)."""
+        return jax.vmap(self.kern.derived, in_axes=-1, out_axes=-1)(svT)
 
-    def guards(self, svb, derb) -> jnp.ndarray:
-        """[B, ...] frontier -> ok [B, A]: every lane's enabling guard,
-        with the successor construction dead-code-eliminated."""
-        def one_state(sv, der):
-            oks = []
-            for fam in self.families:
-                lane = jax.vmap(fam.fn,
-                                in_axes=(None, None) + (0,) * len(fam.params))
-                ok, _sv2 = lane(sv, der,
-                                *[jnp.asarray(p) for p in fam.params])
-                oks.append(ok.reshape(-1))
-            return jnp.concatenate(oks)
-        return jax.vmap(one_state)(svb, derb)
+    def _guard_one(self, sv, der):
+        oks = []
+        for fam in self.families:
+            lane = jax.vmap(fam.fn,
+                            in_axes=(None, None) + (0,) * len(fam.params))
+            ok, _sv2 = lane(sv, der,
+                            *[jnp.asarray(p) for p in fam.params])
+            oks.append(ok.reshape(-1))
+        return jnp.concatenate(oks)
 
-    def materialize(self, svb, derb, okf, epos, fcap: int,
+    def guards_T(self, svT, derT) -> jnp.ndarray:
+        """Batch-LAST frontier [..., B] -> ok [B, A]: every lane's
+        enabling guard, with the successor construction
+        dead-code-eliminated."""
+        ok = jax.vmap(self._guard_one, in_axes=-1, out_axes=-1)(svT, derT)
+        return jnp.moveaxis(ok, -1, 0)
+
+    def materialize(self, svT, derT, okf, epos, fcap: int,
                     fam_caps) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-        """Build the compacted candidate buffer [fcap, ...] from the
-        guard mask.  okf is the flat [B*A] enabled mask, epos the global
+        """Build the compacted candidate buffer [..., fcap] from the
+        guard mask.  svT/derT are BATCH-LAST ([..., B]); okf is the
+        flat [B*A] enabled mask in b-major lane order, epos the global
         compaction position per flat lane (fcap = dropped).  Returns
-        (cand rows in enumeration order, per-family enabled counts —
-        the host grows any family whose count exceeded its cap and
-        replays the level).
+        (cand rows batch-last in enumeration order, per-family enabled
+        counts — the host grows any family whose count exceeded its cap
+        and replays the level).
 
-        Internally everything runs BATCH-MINOR (the row axis vmapped at
-        -1): the per-state arrays have tiny minor dims (S, Lcap, K ≈
-        3-20) which waste the TPU's (8,128) vector tiles when the batch
-        is major — measured 5.6x slower than this layout on v5e."""
+        Everything runs BATCH-MINOR (the row axis vmapped at -1): the
+        per-state arrays have tiny minor dims (S, Lcap, K ≈ 3-20) which
+        waste the TPU's (8,128) vector tiles when the batch is major —
+        measured 5.6x slower than this layout on v5e."""
         B = okf.shape[0] // self.n_lanes
         A = self.n_lanes
         totc = sum(fam_caps)
-        svT = {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()}
-        derT = {k: jnp.moveaxis(v, 0, -1) for k, v in derb.items()}
 
         # ---- one fused compaction for ALL families -------------------
         # The per-family cumsum+scatter chains were ~2x13 serialized
@@ -284,8 +288,7 @@ class Expander:
         concat = {k: jnp.concatenate([o[k] for o in outs], axis=-1)
                   for k in ALL_KEYS}
         take = jnp.clip(mapidx, 0, totc - 1)
-        cand = {k: jnp.moveaxis(v[..., take], -1, 0)
-                for k, v in concat.items()}
+        cand = {k: v[..., take] for k, v in concat.items()}
         return cand, counts
 
     # ---- test/debug path -------------------------------------------------

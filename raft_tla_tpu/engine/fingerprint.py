@@ -91,11 +91,6 @@ class Fingerprinter:
         else:
             perms = [tuple(range(S))]
         self.sigmas = np.array(perms, dtype=np.int32)           # [P, S]
-        invs = np.zeros_like(self.sigmas)
-        for p, sig in enumerate(perms):
-            for i, t in enumerate(sig):
-                invs[p, t] = i
-        self.invs = invs
         # statically permuted salt tables: psalts[p, t, i] is the salt a
         # value at original flat position i hashes against under σ_p —
         # i.e. pos_salts[t][σ_p(position i)]; per-server blocks permute
@@ -257,6 +252,11 @@ class Fingerprinter:
         svT = {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()}
         return self._core(svT, nb=1).T            # [B, n_streams]
 
+    def fingerprint_batch_T(self, svT: Dict) -> jnp.ndarray:
+        """Batch-LAST twin for the engines' batch-minor hot path:
+        [..., B] arrays -> u32[n_streams, B] (no transposes)."""
+        return self._core(svT, nb=1)
+
     def _lex_min(self, hs) -> jnp.ndarray:
         """[P, n_streams, ...] -> [n_streams, ...]: lexicographic min
         over the permutation axis via iterative select (P is small).
@@ -274,10 +274,6 @@ class Fingerprinter:
         return best
 
 
-def combine_u64(fp: np.ndarray) -> np.ndarray:
-    """Host side: [N, n_streams] u32 -> [N, n_streams//2] u64 words (or a
-    single u64 for the default 2-stream mode)."""
-    fp = np.asarray(fp, dtype=np.uint64)
-    hi = fp[:, 0::2]
-    lo = fp[:, 1::2]
-    return (hi << np.uint64(32)) | lo
+# canonical dedup-key bit layout lives in utils (host helpers);
+# re-exported here for back-compat with older imports
+from ..utils import combine_u64  # noqa: E402,F401

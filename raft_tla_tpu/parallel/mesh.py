@@ -167,9 +167,13 @@ class ShardedEngine(Engine):
         sv = widen({k: lax.dynamic_slice_in_dim(v, base, B)
                     for k, v in c["front"].items()})
         fmask = lax.dynamic_slice_in_dim(c["fmask"], base, B)
-        # guard-first expansion (engine/bfs chunk-step twin)
-        derb = self.expander.derived_batch(sv)
-        ok = lax.optimization_barrier(self.expander.guards(sv, derb))
+        # guard-first expansion (engine/bfs chunk-step twin).  The
+        # expander APIs are batch-LAST; this engine keeps its shard
+        # buffers batch-major and transposes at the boundary (the
+        # virtual-CPU test mesh doesn't care about TPU tiling).
+        svT = {k: jnp.moveaxis(v, 0, -1) for k, v in sv.items()}
+        derT = self.expander.derived_batch_T(svT)
+        ok = lax.optimization_barrier(self.expander.guards_T(svT, derT))
         valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
                  c["n_front"]) & fmask
         okf = (ok & valid[:, None]).reshape(N)
@@ -180,9 +184,10 @@ class ShardedEngine(Engine):
         n_e = okf.sum(dtype=jnp.int32)
         eidx = lax.optimization_barrier(
             jnp.full((FC,), N, jnp.int32).at[epos].set(idx, mode="drop"))
-        cand_c, famx = self.expander.materialize(
-            sv, derb, okf, epos, FC, fam_caps)
-        cand_c = lax.optimization_barrier(cand_c)
+        cand_T, famx = self.expander.materialize(
+            svT, derT, okf, epos, FC, fam_caps)
+        cand_c = lax.optimization_barrier(
+            {k: jnp.moveaxis(v, -1, 0) for k, v in cand_T.items()})
         famx = jnp.maximum(c["famx"], famx)
         fovf = c["fovf"] | (n_e > FC) | \
             jnp.any(famx > jnp.asarray(fam_caps, jnp.int32))

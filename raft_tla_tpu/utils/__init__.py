@@ -34,12 +34,19 @@ def take_arrays(arrs: Dict[str, np.ndarray], idx) -> Dict[str, np.ndarray]:
     return {k: v[idx] for k, v in arrs.items()}
 
 
+def combine_u64(fp: np.ndarray) -> np.ndarray:
+    """[N, n_streams] u32 -> [N, n_streams//2] u64 words (a single u64
+    column for the default 2-stream mode) — the canonical bit layout of
+    the dedup key (engine.fingerprint re-exports this)."""
+    fp = np.asarray(fp, dtype=np.uint64)
+    return (fp[:, 0::2] << np.uint64(32)) | fp[:, 1::2]
+
+
 def fp_key(fp_u32: np.ndarray) -> np.ndarray:
     """[N, n_streams] u32 -> 1-D sortable dedup key covering ALL streams:
     plain u64 for the 2-stream default, a lexicographic structured array
     for fp128 (so the extra streams actually buy collision resistance)."""
-    fp = np.asarray(fp_u32, dtype=np.uint64)
-    u64 = (fp[:, 0::2] << np.uint64(32)) | fp[:, 1::2]
+    u64 = combine_u64(fp_u32)
     if u64.shape[1] == 1:
         return u64[:, 0]
     dtype = np.dtype([(f"w{i}", "<u8") for i in range(u64.shape[1])])
