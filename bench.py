@@ -6,13 +6,13 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "states/sec", "vs_baseline": N}
 
 ``vs_baseline`` compares the TPU engine against the repo's native C++
-multi-threaded checker (native/raft_checker.cc) measured on this
-machine over the SAME budgeted run — the machine-measured stand-in for
-the reference's "TLC -workers N" baseline (the reference publishes no
-numbers — BASELINE.md).  Both engines run the same level-granular
-budget and land on the identical distinct-state count (the metric
-config's full space exceeds single-chip HBM at the current 620B/state
-row; BASELINE.md records the exhaustive configs separately).
+checker (native/raft_checker.cc) measured on this machine over the
+SAME depth-exact run — the machine-measured stand-in for the
+reference's "TLC -workers N" baseline (the reference publishes no
+numbers — BASELINE.md).  Both engines run level-exact to depth 19
+(7,619,299 states — the deepest level whose buffers fit single-chip
+HBM; BASELINE.md "round 3" section measures the exhaustion wall) and
+must land on the identical distinct-state count.
 
 Correctness gate: before timing, the engine is differentially checked
 against the Python oracle on a micro config; a mismatch zeroes the
@@ -24,15 +24,11 @@ import os
 import sys
 import time
 
-# The budget stops the run at the end of depth 18 (2,443,370 states on
-# both engines).  Depth 19 needs a >4M-row level buffer, which at the
-# current 620B/state exceeds single-chip HBM alongside the frontier.
-BUDGET = 2_400_000
-LCAP = 1 << 21
-# sized so the visited table never crosses the load bound mid-run (a
-# growth would rehash + retrace the fused kernels: ~100s of remote
-# compile through the tunnel)
-VCAP = 1 << 24
+# Depth-exact headline: both engines run the full space to depth 19.
+# Level-20 frontiers (~25M rows) exceed single-chip HBM — BASELINE.md.
+MAX_DEPTH = 19
+LCAP = 3 << 21            # ≥ the 5.18M-row depth-19 level, no growth
+VCAP = 1 << 25            # 7.62M keys at a 23% load factor
 
 
 def main():
@@ -71,20 +67,33 @@ def main():
                                         max_client_requests=3))
     cfg = cfg.with_(invariants=("ElectionSafety",))
 
-    budget = int(float(sys.argv[1])) if len(sys.argv) > 1 else BUDGET
+    # optional override: `python bench.py --max-depth N` (NOTE: the
+    # round-2 positional arg was a STATE BUDGET; the metric is now
+    # depth-exact, so a bare positional number is rejected to avoid
+    # silently reinterpreting old invocations)
+    max_depth = MAX_DEPTH
+    if len(sys.argv) > 2 and sys.argv[1] == "--max-depth":
+        max_depth = int(sys.argv[2])
+        if not 1 <= max_depth <= 64:
+            raise SystemExit(f"--max-depth {max_depth}: BFS depths are "
+                             "small (the round-2 budget arg is gone)")
+    elif len(sys.argv) > 1:
+        raise SystemExit("usage: python bench.py [--max-depth N]   "
+                         "(the metric is depth-exact now; the old "
+                         "positional state budget was removed)")
 
-    # -- CPU baseline: the native multi-threaded checker ----------------
+    # -- CPU baseline: the native checker, same depth-exact run ---------
     threads = os.cpu_count() or 8
-    nat = native.check(cfg, threads=threads, max_states=budget)
+    nat = native.check(cfg, threads=threads, max_depth=max_depth)
     nat_rate = nat.states_per_sec
 
-    # -- TPU engine, same budget ----------------------------------------
+    # -- TPU engine, same depth ----------------------------------------
     eng = Engine(cfg, chunk=2048, store_states=False, lcap=LCAP, vcap=VCAP)
     t_compile = time.time()
     eng.check(max_depth=2)                      # warm the jit caches
     t_compile = time.time() - t_compile
     t0 = time.time()
-    r = eng.check(max_states=budget)
+    r = eng.check(max_depth=max_depth)
     secs = time.time() - t0
     rate = r.distinct_states / max(secs, 1e-9)
 
@@ -100,6 +109,7 @@ def main():
         "detail": {
             "distinct_states": int(r.distinct_states),
             "depth": int(r.depth),
+            "depth_exact": True,      # no budget cap: full space to depth
             "seconds": round(secs, 2),
             "compile_seconds": round(t_compile, 1),
             "violations": len(r.violations),
@@ -109,9 +119,13 @@ def main():
             "baseline_native_threads": threads,
             "correctness_gate": bool(gate_ok),
             "counts_match_native": bool(count_ok),
-            "exhausted": bool(r.distinct_states < budget),
+            # the full space exceeds ~1e8 states (BASELINE.md round-3
+            # exhaustion-wall measurements); depth 19 is the deepest
+            # single-chip level-exact run
+            "exhausted": False,
             # the dedup-exhaustiveness claim's collision bound
-            # (64-bit fingerprints; ADVICE r1, SURVEY §7.4 pt 4)
+            # (64-bit fingerprints; fp128 parity recorded in
+            # baseline_runs/round3_deep.json)
             "expected_fp_collisions": float(
                 r.distinct_states ** 2 / 2.0 ** 65),
         },

@@ -600,10 +600,9 @@ class Engine:
         # transfer per chunk through the tunneled-TPU runtime
         # Frontier rows are stored narrow (codec.narrow_dtypes) and
         # BATCH-LAST ([..., LCAP]): the tiny per-state dims (S, Lcap,
-        # K) stay off the TPU's 128-lane axis, and the loop-carried
-        # buffers tile without padding blowups — which is what lets
-        # _chunk_steps_k run several chunks per dispatch (a dispatch
-        # through the tunneled runtime costs ~10ms flat).
+        # K) are far smaller than the TPU's (8, 128) vector tiles, so
+        # keeping them off the lane axis is worth ~5x on the successor
+        # materialization (expand.Expander.materialize docstring).
         sv = widen({k: lax.dynamic_slice_in_dim(v, base, B,
                                                 axis=v.ndim - 1)
                     for k, v in carry["front"].items()})
@@ -1046,7 +1045,17 @@ class Engine:
                             fam_over = True
                     self.FAM_CAPS = tuple(caps)
                     if not fam_over:
-                        self.FCAP *= 4
+                        # the TOTAL enabled count blew the compaction
+                        # buffer.  Grow to what the measured per-family
+                        # maxima need (Σfamx bounds any chunk's n_e),
+                        # not a blind 4x: an oversized FCAP widens the
+                        # fingerprint/dedup/append work of EVERY later
+                        # chunk (a 4x overshoot measured ~4x slower
+                        # steady-state on the membership config)
+                        self.FCAP = self._round_cap(min(
+                            self.chunk * self.A,
+                            max(2 * self.FCAP,
+                                (5 * int(sum(famx))) // 4)))
                 if ovf or self.LCAP < 4 * self.FCAP:
                     self.LCAP = self._round_cap(
                         max((4 * self.LCAP) if ovf else self.LCAP,

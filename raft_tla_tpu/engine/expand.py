@@ -116,7 +116,7 @@ _FAMILY_DENSITY = {
     "RequestVote": 2, "BecomeLeader": 1, "ClientRequest": 2,
     "AdvanceCommitIndex": 2, "AppendEntries": 2,
     "UpdateTerm": 2, "CocDiscard": 1, "Receive": 4,
-    "Duplicate": 4, "Drop": 4, "AddNewServer": 2, "DeleteServer": 1,
+    "Duplicate": 4, "Drop": 4, "AddNewServer": 2, "DeleteServer": 2,
 }
 
 
