@@ -37,11 +37,13 @@ the oracle history here for encoding mid-trace states):
     F_NJBL             BecomeLeader by a previously-added server
     F_LCDCC            BecomeLeader while F_OPEN_ADD      (raft.tla:1268-1278)
     F_ADD_COMMITS      CommitMembershipChange ∩ addedSet  (raft.tla:1248-1256)
-    F_PREFIX_MASK      bitmask over symmetry assignments still extending the
-                       punctuated-search prefix (raft.tla:1198-1204); -1 when
-                       no prefix pin is configured.  STUB for now: always -1;
-                       wired up with the punctuated-search feature (the cfg
-                       has no prefix-pin field yet)
+    F_PREFIX_MASK      RESERVED, always -1.  The punctuated-search prefix
+                       pins (raft.tla:1198-1204) compile into BFS seed
+                       states instead (cfg prefix_pins ->
+                       models/golden.prefix_pin_seeds), so no per-state
+                       prefix tracking is needed; the lane is kept so a
+                       future in-flight IsPrefix mask has a home without
+                       a layout change
     F_MC_COMMITS       count of CommitMembershipChange records — feeds
                        MembershipChangeCommits / MultipleMembership-
                        ChangesCommit (raft.tla:1239-1246)

@@ -71,7 +71,10 @@ DEPTH = {4: 10}
 ENGINE_KW = {
     1: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24),
     2: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24),
-    3: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24),
+    # fcap pre-sized: the membership model averages ~20 enabled
+    # lanes/parent, so the default chunk*16 compaction buffer
+    # overflows mid-run (growth = ~100s replay+recompile)
+    3: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24, fcap=1 << 16),
     4: dict(chunk=1024, lcap=1 << 17, vcap=1 << 20),
     5: dict(chunk=512, lcap=1 << 20, vcap=1 << 23),
 }
