@@ -17,6 +17,12 @@ must land on the identical distinct-state count.
 Correctness gate: before timing, the engine is differentially checked
 against the Python oracle on a micro config; a mismatch zeroes the
 score (guards against accelerator-path miscompiles).
+
+Perf floor (BENCH_FLOOR.json): rounds 1->2->3 measured 68x and 3.5x
+rate swings, so a silent regression would otherwise ship green.  A run
+below warn_frac x best-recorded-rate is flagged in detail.perf_floor;
+below hard_frac x best (under the measured tunnel noise band) the
+score is zeroed.  A new best rewrites the floor file.
 """
 
 import json
@@ -29,6 +35,39 @@ import time
 MAX_DEPTH = 19
 LCAP = 3 << 21            # ≥ the 5.18M-row depth-19 level, no growth
 VCAP = 1 << 25            # 7.62M keys at a 23% load factor
+
+
+def perf_floor(rate, max_depth, plat, floor_path, gate_ok=True,
+               allow_bump=True):
+    """Perf regression floor (VERDICT r3 #5; tests/test_bench.py).
+
+    Returns (floor_info dict or None, zero_score bool).  Only applies
+    to the headline-depth run on the recorded machine class — a
+    shallower run pays proportionally more per-level dispatch/compile
+    and its rate isn't comparable.  A new best (gate passing, >2% up)
+    rewrites the floor file so the floor ratchets with the engine."""
+    try:
+        fl = json.load(open(floor_path))["tlc_membership_S3_T3_L3"]
+    except (OSError, KeyError, ValueError):
+        return None, False
+    if not str(plat).upper().startswith(fl["platform_prefix"].upper()):
+        return {"status": f"skipped (platform {plat!r})"}, False
+    if max_depth != MAX_DEPTH:
+        return {"status": "skipped (non-headline depth)"}, False
+    best = float(fl["best_states_per_sec"])
+    warn, hard = best * fl["warn_frac"], best * fl["hard_frac"]
+    status = ("ok" if rate >= warn else
+              "warn" if rate >= hard else "hard")
+    info = {"best_states_per_sec": best, "warn_below": round(warn, 1),
+            "hard_below": round(hard, 1), "status": status}
+    if allow_bump and gate_ok and rate > best * 1.02:
+        data = json.load(open(floor_path))
+        data["tlc_membership_S3_T3_L3"]["best_states_per_sec"] = \
+            round(rate, 1)
+        data["tlc_membership_S3_T3_L3"]["source"] = "bench.py auto-bump"
+        with open(floor_path, "w") as fh:
+            json.dump(data, fh, indent=2)
+    return info, status == "hard"
 
 
 def main():
@@ -67,20 +106,29 @@ def main():
                                         max_client_requests=3))
     cfg = cfg.with_(invariants=("ElectionSafety",))
 
-    # optional override: `python bench.py --max-depth N` (NOTE: the
-    # round-2 positional arg was a STATE BUDGET; the metric is now
-    # depth-exact, so a bare positional number is rejected to avoid
-    # silently reinterpreting old invocations)
-    max_depth = MAX_DEPTH
-    if len(sys.argv) > 2 and sys.argv[1] == "--max-depth":
-        max_depth = int(sys.argv[2])
-        if not 1 <= max_depth <= 64:
-            raise SystemExit(f"--max-depth {max_depth}: BFS depths are "
-                             "small (the round-2 budget arg is gone)")
-    elif len(sys.argv) > 1:
-        raise SystemExit("usage: python bench.py [--max-depth N]   "
-                         "(the metric is depth-exact now; the old "
-                         "positional state budget was removed)")
+    # optional overrides: `python bench.py [--max-depth N] [--chunk C]`
+    # (NOTE: the round-2 positional arg was a STATE BUDGET; the metric
+    # is now depth-exact, so a bare positional number is rejected to
+    # avoid silently reinterpreting old invocations).  --chunk exists
+    # to let the perf-floor trip be exercised deliberately.
+    max_depth, chunk = MAX_DEPTH, 2048
+    argv = sys.argv[1:]
+    while argv:
+        if len(argv) >= 2 and argv[0] == "--max-depth":
+            max_depth = int(argv[1])
+            if not 1 <= max_depth <= 64:
+                raise SystemExit(f"--max-depth {max_depth}: BFS depths "
+                                 "are small (the round-2 budget arg is "
+                                 "gone)")
+            argv = argv[2:]
+        elif len(argv) >= 2 and argv[0] == "--chunk":
+            chunk = int(argv[1])
+            argv = argv[2:]
+        else:
+            raise SystemExit("usage: python bench.py [--max-depth N] "
+                             "[--chunk C]   (the metric is depth-exact "
+                             "now; the old positional state budget was "
+                             "removed)")
 
     # -- CPU baseline: the native checker, same depth-exact run ---------
     threads = os.cpu_count() or 8
@@ -88,7 +136,8 @@ def main():
     nat_rate = nat.states_per_sec
 
     # -- TPU engine, same depth ----------------------------------------
-    eng = Engine(cfg, chunk=2048, store_states=False, lcap=LCAP, vcap=VCAP)
+    eng = Engine(cfg, chunk=chunk, store_states=False, lcap=LCAP,
+                 vcap=VCAP)
     t_compile = time.time()
     eng.check(max_depth=2)                      # warm the jit caches
     t_compile = time.time() - t_compile
@@ -101,11 +150,25 @@ def main():
                 r.depth == nat.depth)
     gate_ok = gate_ok and count_ok
 
+    # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------
+    # Only meaningful for the full-depth run on the recorded machine
+    # class: a shallower --max-depth pays proportionally more per-level
+    # dispatch/compile and would false-trip.
+    import jax
+    floor_info, floor_zero = perf_floor(
+        rate, max_depth, str(jax.devices()[0].device_kind),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_FLOOR.json"), gate_ok=gate_ok,
+        # only the default-chunk headline run may ratchet the floor — a
+        # hand-tuned --chunk rate would zero future default runs
+        allow_bump=(chunk == 2048))
+
+    scored = gate_ok and not floor_zero
     out = {
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
-        "value": round(rate if gate_ok else 0.0, 1),
+        "value": round(rate if scored else 0.0, 1),
         "unit": "states/sec",
-        "vs_baseline": round((rate / nat_rate) if gate_ok else 0.0, 2),
+        "vs_baseline": round((rate / nat_rate) if scored else 0.0, 2),
         "detail": {
             "distinct_states": int(r.distinct_states),
             "depth": int(r.depth),
@@ -119,6 +182,7 @@ def main():
             "baseline_native_threads": threads,
             "correctness_gate": bool(gate_ok),
             "counts_match_native": bool(count_ok),
+            "perf_floor": floor_info,
             # the full space exceeds ~1e8 states (BASELINE.md round-3
             # exhaustion-wall measurements); depth 19 is the deepest
             # single-chip level-exact run

@@ -41,6 +41,18 @@ def _apply_overrides(cfg, args):
         init = args.init_servers if args.init_servers is not None \
             else args.servers
         kw["init_servers"] = tuple(range(init))
+        # MaxInFlightMessages is a FORMULA over Server in the spec
+        # (2·|S|² tlc / 4·|S|² apalache, raft.tla:30); the parser lifts
+        # its value at the cfg's |Server|, so a --servers override must
+        # recompute it — otherwise a shrunk model keeps the big model's
+        # bag capacity (e.g. K=19 at S=2, a shape the remote TPU
+        # compiler chokes on for >15 min)
+        old_n, new_n = cfg.n_servers, args.servers
+        ov = cfg.max_inflight_override
+        if ov == 2 * old_n * old_n:
+            kw["max_inflight_override"] = 2 * new_n * new_n
+        elif ov == 4 * old_n * old_n:
+            kw["max_inflight_override"] = 4 * new_n * new_n
     elif args.init_servers is not None:
         kw["init_servers"] = tuple(range(args.init_servers))
     if args.symmetry is not None:
@@ -148,6 +160,10 @@ def cmd_check(args):
         print("--resume and --seed-trace are mutually exclusive",
               file=sys.stderr)
         return 2
+    if getattr(args, "spill", False) and (args.resume or args.checkpoint):
+        print("--spill does not checkpoint yet (engine/spill docstring)",
+              file=sys.stderr)
+        return 2
     oracle_seeds = engine_seeds = None
     if args.seed_trace:
         oracle_seeds, raw = _load_seeds(args.seed_trace)
@@ -183,8 +199,16 @@ def cmd_check(args):
             r.generated_states
     else:
         from .engine.bfs import CheckpointError, Engine
-        eng = Engine(cfg, chunk=args.chunk,
-                     store_states=not args.no_store)
+        if args.spill:
+            # host-spill engine: levels stream through host RAM, for
+            # depths whose level buffers exceed HBM (engine/spill)
+            from .engine.spill import SpillEngine
+            eng = SpillEngine(cfg, chunk=args.chunk,
+                              store_states=not args.no_store,
+                              seg=args.seg)
+        else:
+            eng = Engine(cfg, chunk=args.chunk,
+                         store_states=not args.no_store)
         try:
             r = eng.check(max_depth=args.max_depth,
                           max_states=args.max_states,
@@ -204,7 +228,12 @@ def cmd_check(args):
         secs = r.seconds
         viol = []
         for v in r.violations[:args.max_violations]:
-            if not args.no_store:
+            if v.state_id < 0:
+                # pinned-prefix interior state (models/golden): checked
+                # at seed time, never entered BFS — no parent chain
+                trace = [("(pinned-prefix interior state — precedes "
+                          "the seeded witness end)", v.state)]
+            elif not args.no_store:
                 trace = eng.trace(v.state_id)
             elif v.state is not None:
                 # no parent archive, but the violating state itself was
@@ -230,6 +259,10 @@ def cmd_check(args):
         "dedup_hit_rate": round(1.0 - distinct / max(gen, 1), 4),
         "violations": len(viol),
     }
+    if getattr(r, "pin_interior_states", 0):
+        # TLC counts the pinned-prefix interior states; we check them
+        # but seed past them — surface the divergence bound
+        out["pin_interior_states"] = int(r.pin_interior_states)
     if args.engine != "oracle":
         # dedup is fingerprint-based (TLC semantics): surface the
         # expected-collision bound the exhaustiveness claim rests on
@@ -244,6 +277,14 @@ def cmd_check(args):
             print(f"\nViolation {k}: {name}")
             if trace:
                 print("  " + " -> ".join(trace))
+            elif trace is None:
+                # pinned-prefix interior state (models/golden): outside
+                # the BFS parent map, so there is no action trace.
+                # (A ROOT violation has an EMPTY trace, not None.)
+                print("  (pinned-prefix interior state — precedes the "
+                      "seeded witness end)")
+            else:
+                print("  (violation at a root state — empty trace)")
         else:
             _print_violation(k, name, trace)
     return 1 if viol else 0
@@ -349,6 +390,12 @@ def main(argv=None):
     common(pc)
     pc.add_argument("--keep-going", action="store_true",
                     help="do not stop at the first violation")
+    pc.add_argument("--spill", action="store_true",
+                    help="host-spill engine: stream levels through "
+                         "host RAM (TLC's disk-spill counterpart) — "
+                         "required past the single-chip HBM depth wall")
+    pc.add_argument("--seg", type=int, default=1 << 21,
+                    help="spill segment capacity in states (--spill)")
     pc.add_argument("--no-store", action="store_true",
                     help="do not retain states (no traces; less memory)")
     pc.add_argument("--max-violations", type=int, default=5)

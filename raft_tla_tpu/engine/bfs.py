@@ -121,6 +121,12 @@ class CheckResult:
     # `violations` list holds only this controller's shards, but this
     # count (from the replicated scalar matrix) is global
     violations_global: int = 0
+    # punctuated search from cfg prefix pins seeds BFS at the witness
+    # END state (models/golden docstring); TLC also counts the prefix
+    # interior states.  This is the number of distinct interior states
+    # the engine invariant-checked but did NOT count — the upper bound
+    # on the distinct_states divergence from TLC for pinned cfgs.
+    pin_interior_states: int = 0
 
     @property
     def states_per_sec(self):
@@ -184,6 +190,7 @@ def ckpt_write(path, carry, store_states, parents, lanes, states, res,
                 faults=res.overflow_faults,
                 level_sizes=res.level_sizes,
                 viol_global=res.violations_global,
+                pin_interior=res.pin_interior_states,
                 n_levels=len(parents), store_states=store_states)
     data["meta"] = np.array(json.dumps({**base, **meta}))
     tmp = path + ".tmp.npz"           # .npz suffix: savez won't append
@@ -271,7 +278,9 @@ def ckpt_result(z, meta) -> "CheckResult":
         generated_states=meta["generated"], depth=meta["depth"],
         level_sizes=list(meta["level_sizes"]),
         overflow_faults=meta["faults"],
-        violations_global=meta["viol_global"])
+        violations_global=meta["viol_global"],
+        # .get: round-3 checkpoints predate the field
+        pin_interior_states=meta.get("pin_interior", 0))
     for nm, sid in zip(z["viol_names"], z["viol_ids"]):
         res.violations.append(Violation(str(nm), int(sid)))
     return res
@@ -289,11 +298,15 @@ class Engine:
     def __init__(self, cfg: ModelConfig, chunk: int = 512,
                  store_states: bool = True,
                  lcap: int = 1 << 14, vcap: int = 1 << 17,
-                 fcap: Optional[int] = None):
+                 fcap: Optional[int] = None,
+                 incremental_fp: bool = True):
         enable_persistent_compilation_cache()
         self.cfg = cfg
         self.chunk = max(16, int(chunk))
         self.store_states = store_states
+        # incremental per-action fingerprints (auto-off for big
+        # symmetry groups — fingerprint.supports_incremental)
+        self.incremental_fp = incremental_fp
         self.lay = Layout(cfg)
         self.kern = RaftKernels(self.lay)
         self.expander = Expander(cfg)
@@ -563,6 +576,64 @@ class Engine:
     # fused per-chunk step (ONE device call per frontier chunk)
     # ------------------------------------------------------------------
 
+    def _expand_fp_chunk(self, sv, valid, fam_caps, FCAP):
+        """Shared front half of a chunk step (this engine's fused step
+        and engine/spill's streamed step): guard-first expansion over
+        the [B, A] lane grid, compaction of enabled lanes into the FCAP
+        buffer, successor materialization, ACTION_CONSTRAINTS, and the
+        symmetry-canonical fingerprint of the compacted candidates.
+
+        Returns (cand_c [..., FCAP] batch-last, elive [FCAP], fp
+        [W, FCAP], take [FCAP] flat lane ids, famx_chunk [n_fams]
+        per-family enabled counts, n_e enabled total).  Callers fold
+        famx/fovf into their carries.
+
+        Fingerprints run INCREMENTALLY when the config supports it
+        (fingerprint.py "Incremental per-action fingerprints"): one
+        full per-term hash per PARENT, per-candidate deltas over the
+        action family's touched positions — bit-identical to the
+        direct path (tests/test_codec.py) at a fraction of the work on
+        wide-expansion configs."""
+        B, A = self.chunk, self.A
+        N = B * A
+        derb = self.expander.derived_batch_T(sv)
+        ok = lax.optimization_barrier(self.expander.guards_T(sv, derb))
+        okf = (ok & valid[:, None]).reshape(N)
+
+        # compact enabled lanes into FCAP (ascending lane index =
+        # the oracle's successor enumeration order)
+        idx = jnp.arange(N, dtype=jnp.int32)
+        epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1,
+                         FCAP)                           # OOB drops
+        n_e = okf.sum(dtype=jnp.int32)
+        incr = self.incremental_fp and self.fpr.supports_incremental()
+        if incr:
+            tables = lax.optimization_barrier(
+                self.fpr.parent_tables(sv))
+            cand_c, famx, fp = self.expander.materialize(
+                sv, derb, okf, epos, FCAP, fam_caps,
+                delta_fp=(self.fpr, tables))
+        else:
+            cand_c, famx = self.expander.materialize(
+                sv, derb, okf, epos, FCAP, fam_caps)
+        cand_c = lax.optimization_barrier(cand_c)        # [..., FCAP]
+        elive = jnp.arange(FCAP, dtype=jnp.int32) < n_e
+        eidx = lax.optimization_barrier(
+            jnp.full((FCAP,), N, jnp.int32).at[epos].set(
+                idx, mode="drop"))                       # slot -> lane
+        take = jnp.clip(eidx, 0, N - 1)
+        if self.act_names:
+            # ACTION_CONSTRAINTS on the compacted (parent, successor)
+            # pairs: violating transitions are killed before dedup
+            par_c = {k: v[..., take // A] for k, v in sv.items()}
+            act = jax.vmap(self._act_ok, in_axes=-1)(par_c, cand_c)
+            elive = elive & act
+        if not incr:
+            # direct path: full min-over-perms hash per candidate
+            fp = self.fpr.fingerprint_batch_T(cand_c)    # [W, FCAP]
+        fp = lax.optimization_barrier(fp)
+        return cand_c, elive, fp, take, famx, n_e
+
     def _chunk_step_impl(self, carry, fam_caps):
         """Expand frontier[base:base+chunk], fingerprint, dedup via the
         visited hash table (claim-insert: intra-chunk first-seen,
@@ -607,45 +678,18 @@ class Engine:
                                                 axis=v.ndim - 1)
                     for k, v in carry["front"].items()})
         fmask = lax.dynamic_slice_in_dim(carry["fmask"], base, B)
-        # guard-first expansion: guards over the whole lane grid (the
-        # successor construction is DCE'd), successors materialized only
-        # for enabled lanes (expand.Expander.materialize)
-        derb = self.expander.derived_batch_T(sv)
-        ok = lax.optimization_barrier(self.expander.guards_T(sv, derb))
-        # fmask carries both the live-row bound and the CONSTRAINT
-        # prune-not-expand mask (SURVEY §2.8)
+        # guard-first expansion + compaction + fingerprint: the shared
+        # front half (_expand_fp_chunk).  fmask carries both the
+        # live-row bound and the CONSTRAINT prune-not-expand mask
+        # (SURVEY §2.8)
         valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
                  carry["n_front"]) & fmask
-        okf = (ok & valid[:, None]).reshape(N)
-
-        # compact enabled lanes into FCAP (ascending lane index =
-        # the oracle's successor enumeration order)
-        idx = jnp.arange(N, dtype=jnp.int32)
-        epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1,
-                         FCAP)                           # OOB drops
-        n_e = okf.sum(dtype=jnp.int32)
-        eidx = lax.optimization_barrier(
-            jnp.full((FCAP,), N, jnp.int32).at[epos].set(
-                idx, mode="drop"))                       # slot -> lane
-        cand_c, famx = self.expander.materialize(
-            sv, derb, okf, epos, FCAP, fam_caps)
-        cand_c = lax.optimization_barrier(cand_c)        # [..., FCAP]
-        famx = jnp.maximum(carry["famx"], famx)
+        cand_c, elive, fp, take, famx_c, n_e = self._expand_fp_chunk(
+            sv, valid, fam_caps, FCAP)
+        famx = jnp.maximum(carry["famx"], famx_c)
         fovf = carry["fovf"] | (n_e > FCAP) | \
             jnp.any(famx > jnp.asarray(fam_caps, jnp.int32))
-        elive = jnp.arange(FCAP, dtype=jnp.int32) < n_e
-        take = jnp.clip(eidx, 0, N - 1)
-        if self.act_names:
-            # ACTION_CONSTRAINTS on the compacted (parent, successor)
-            # pairs: violating transitions are killed before dedup
-            par_c = {k: v[..., take // A] for k, v in sv.items()}
-            act = jax.vmap(self._act_ok, in_axes=-1)(par_c, cand_c)
-            elive = elive & act
         n_gen = carry["n_gen"] + elive.sum(dtype=jnp.int32)
-
-        # fingerprint only the compacted candidates
-        fp = lax.optimization_barrier(
-            self.fpr.fingerprint_batch_T(cand_c))        # [W, FCAP]
         keys = tuple(jnp.where(elive, fp[w], U32MAX)
                      for w in range(W))
         # any overflow means this level replays — stop inserting so the
@@ -851,6 +895,33 @@ class Engine:
 
     # ------------------------------------------------------------------
 
+    def _dedup_roots(self, seed_states):
+        """Shared root-admission front half (this engine, ShardedEngine
+        and SpillEngine): cfg prefix pins compile to seeds
+        (raft.tla:1198-1234; models/golden docstring), seeds encode to
+        SoA rows, and first-seen fingerprint dedup picks the root set.
+        Returns (roots int32 SoA [n, ...] batch-major, rk u32 [n, W]
+        canonical fingerprints, pin_interiors or None)."""
+        pin_interiors = None
+        if seed_states is None and self.cfg.prefix_pins:
+            from ..models.golden import prefix_pin_seeds
+            seed_states, pin_interiors = prefix_pin_seeds(
+                self.cfg, with_interior=True)
+        init_list = (seed_states if seed_states is not None
+                     else [init_state(self.cfg)])
+        init_arrs = widen(_cat([
+            {k: np.asarray(v)[None] for k, v in s.items()}
+            if isinstance(s, dict) else
+            {k: v[None] for k, v in encode(self.lay, *s).items()}
+            for s in init_list]))
+        rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
+        root_fp = np.asarray(self._rootfp_jit(rootsb)).astype(np.uint32)
+        _uniq, first_idx = np.unique(fp_key(root_fp),
+                                     return_index=True)
+        first_idx.sort()
+        return _take(init_arrs, first_idx), root_fp[first_idx], \
+            pin_interiors
+
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
               seed_states: Optional[List] = None,
@@ -880,29 +951,12 @@ class Engine:
             n_front = meta["n_front"]
             resumed = True
         else:
-            if seed_states is None and self.cfg.prefix_pins:
-                # cfg-declared punctuated-search pins compile to seeds
-                # (raft.tla:1198-1234; models/golden docstring)
-                from ..models.golden import prefix_pin_seeds
-                seed_states = prefix_pin_seeds(self.cfg)
-            init_list = (seed_states if seed_states is not None
-                         else [init_state(self.cfg)])
-            init_arrs = _cat([
-                {k: np.asarray(v)[None] for k, v in s.items()}
-                if isinstance(s, dict) else
-                {k: v[None] for k, v in encode(lay, *s).items()}
-                for s in init_list])
-            init_arrs = widen(init_arrs)   # kernels'/fp int32 contract
-            rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
-            root_fp = np.asarray(self._rootfp_jit(rootsb))
-            root_keys = fp_key(root_fp)
-            _uniq, first_idx = np.unique(root_keys, return_index=True)
-            first_idx.sort()
-            roots = _take(init_arrs, first_idx)
-            n_roots = len(first_idx)
+            roots, rk, pin_interiors = self._dedup_roots(seed_states)
+            n_roots = len(rk)
 
             res = CheckResult(distinct_states=0,
                               generated_states=n_roots, depth=0)
+            self._check_pin_interiors(pin_interiors, res)
             while self.LCAP - self.FCAP < 2 * n_roots:
                 self.LCAP *= 2
             while n_roots + self.LCAP - self.FCAP > \
@@ -920,7 +974,6 @@ class Engine:
                 [roots_n[k], np.zeros(roots_n[k].shape[:-1] + (pad,),
                                       roots_n[k].dtype)], axis=-1))
                 for k in roots_n}
-            rk = np.asarray(root_fp[first_idx], dtype=np.uint32)
             slots = self._host_probe_assign(rk)
             sl = jnp.asarray(slots)
             carry["vis"] = tuple(
@@ -1106,6 +1159,35 @@ class Engine:
         res.phase_seconds["device_levels"] = t_dev
         return res
 
+    def _check_pin_interiors(self, interiors, res: CheckResult):
+        """Invariant-check the replayed pinned-prefix interior states.
+
+        TLC counts and invariant-checks every prefix state; seeding at
+        the witness end skips them (models/golden docstring).  The
+        interiors are already materialized by replay(), so check them
+        here — a violation inside the pinned prefix gets reported with
+        state_id=-1 (it has no BFS id) — and record the distinct count
+        in CheckResult.pin_interior_states as the divergence bound."""
+        if not interiors:
+            return
+        arrs = widen(_cat([{k: v[None] for k, v in
+                            encode(self.lay, *s).items()}
+                           for s in interiors]))
+        b = {k: jnp.asarray(v) for k, v in arrs.items()}
+        keys = fp_key(np.asarray(self._rootfp_jit(b)))
+        _uniq, first = np.unique(keys, return_index=True)
+        first.sort()
+        res.pin_interior_states = len(first)
+        if not self.inv_names:
+            return
+        inv = np.asarray(self._phase2(b)[0])       # [B, n_inv]
+        for j, nm in enumerate(self.inv_names):
+            for s in np.nonzero(~inv[first, j])[0]:
+                sv, h = interiors[int(first[s])]
+                res.violations.append(
+                    Violation(nm, -1, state=sv, hist=h))
+                res.violations_global += 1
+
     # ------------------------------------------------------------------
     # checkpoint / resume (see the module-level ckpt_* serializer)
     # ------------------------------------------------------------------
@@ -1123,6 +1205,11 @@ class Engine:
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
                             ("LCAP", "VCAP", "FCAP", "fam_caps",
                              "layout"), sharded=False)
+        if meta["layout"] != 2:
+            raise CheckpointError(
+                f"{path}: checkpoint storage layout {meta['layout']!r} "
+                "!= 2 (this engine's batch-last/narrow-dtype layout) — "
+                "re-run without --resume")
         self.LCAP, self.VCAP, self.FCAP = (meta["LCAP"], meta["VCAP"],
                                            meta["FCAP"])
         self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])

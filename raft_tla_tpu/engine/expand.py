@@ -203,7 +203,8 @@ class Expander:
         return jnp.moveaxis(ok, -1, 0)
 
     def materialize(self, svT, derT, okf, epos, fcap: int,
-                    fam_caps) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+                    fam_caps, delta_fp=None) \
+            -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
         """Build the compacted candidate buffer [..., fcap] from the
         guard mask.  svT/derT are BATCH-LAST ([..., B]); okf is the
         flat [B*A] enabled mask in b-major lane order, epos the global
@@ -211,6 +212,12 @@ class Expander:
         (cand rows batch-last in enumeration order, per-family enabled
         counts — the host grows any family whose count exceeded its cap
         and replays the level).
+
+        delta_fp — optional (Fingerprinter, parent_tables) pair: each
+        family also computes its candidates' per-permutation hashes
+        incrementally from the parent tables (fingerprint.family_delta)
+        and a third return value fp [n_streams, fcap] carries the
+        sealed canonical fingerprints.
 
         Everything runs BATCH-MINOR (the row axis vmapped at -1): the
         per-state arrays have tiny minor dims (S, Lcap, K ≈ 3-20) which
@@ -271,6 +278,7 @@ class Expander:
 
         # ---- per-family successor kernels on their buffer slices -----
         outs = []
+        fp_outs = []
         off = 0
         for fi, (fam, cap) in enumerate(zip(self.families, fam_caps)):
             nf = fam.n_lanes
@@ -284,12 +292,19 @@ class Expander:
                 fam.fn, in_axes=(-1, -1) + (0,) * len(fam.params),
                 out_axes=(0, -1))(sv_rows, der_rows, *prm_rows)
             outs.append(sv2)
+            if delta_fp is not None:
+                fpr, tables = delta_fp
+                fp_outs.append(fpr.family_delta(
+                    fam.name, tables, b_idx, sv_rows, sv2, prm_rows))
             off += nf
         concat = {k: jnp.concatenate([o[k] for o in outs], axis=-1)
                   for k in ALL_KEYS}
         take = jnp.clip(mapidx, 0, totc - 1)
         cand = {k: v[..., take] for k, v in concat.items()}
-        return cand, counts
+        if delta_fp is None:
+            return cand, counts
+        h_all = jnp.concatenate(fp_outs, axis=-1)[..., take]
+        return cand, counts, delta_fp[0].finish_min(h_all)
 
     # ---- test/debug path -------------------------------------------------
     def expand_one(self, arrs: Dict[str, np.ndarray]):

@@ -228,12 +228,14 @@ class Fingerprinter:
         hs_all = jax.vmap(one_perm)(
             jnp.asarray(self.sigmas),
             jnp.asarray(self.psalts))             # [P, n_streams, ...]
-        best = self._lex_min(hs_all)
-        # the engines' visited tables use the all-ones key as the
-        # empty-slot sentinel; an all-ones fingerprint would alias it
-        # and be re-admitted as fresh on EVERY regeneration (unlike an
-        # ordinary fp collision, which miscounts once).  Remap it to a
-        # fixed alternate so the sentinel is unreachable by real keys.
+        return self._seal(self._lex_min(hs_all))
+
+    def _seal(self, best):
+        """The engines' visited tables use the all-ones key as the
+        empty-slot sentinel; an all-ones fingerprint would alias it
+        and be re-admitted as fresh on EVERY regeneration (unlike an
+        ordinary fp collision, which miscounts once).  Remap it to a
+        fixed alternate so the sentinel is unreachable by real keys."""
         allones = jnp.ones(best.shape[1:], bool)
         for t in range(self.n_streams):
             allones = allones & (best[t] == U32(0xFFFFFFFF))
@@ -272,6 +274,346 @@ class Fingerprinter:
                 eq = eq & (cand[t] == best[t])
             best = jnp.where(less, cand, best)
         return best
+
+    # ==================================================================
+    # Incremental per-action fingerprints (VERDICT r3 #2/#3).
+    #
+    # Because every stream is a COMMUTATIVE u32 sum of per-position /
+    # per-bag-slot terms, a successor's per-permutation hash is exactly
+    #
+    #   h_p(s') = h_p(s) + Σ_{touched pos i} [term_p(new_i) − term_p(old_i)]
+    #           + Σ_{changed slot k} [bagterm_p(new_k) − bagterm_p(old_k)]
+    #
+    # (u32 modular addition is associative/commutative, so this is
+    # BIT-IDENTICAL to the direct sum — tests/test_codec.py pins it).
+    # The engine therefore computes, ONCE per frontier chunk, a table
+    # of every parent's per-position terms (one full hash per PARENT),
+    # and each candidate only evaluates terms at its action family's
+    # statically-known touched-position superset (unchanged positions
+    # cancel exactly, so supersets are sound).  At ~4-20 enabled lanes
+    # per parent this collapses the per-candidate fingerprint work —
+    # the measured dominant phase on the wide membership config
+    # (BASELINE.md config #3) — by ~6-10x.
+    #
+    # Per-family touch supersets are derived from ops/kernels.py (each
+    # kernel's masked writes); the bag side is a generic <=2-changed-
+    # slot diff (every action sends and/or consumes at most one
+    # message each — SURVEY §2.4/§2.5).
+    # ==================================================================
+
+    # families whose kernels touch the message bag (ops/kernels.py)
+    _BAG_FAMILIES = frozenset((
+        "RequestVote", "AppendEntries", "CocDiscard", "Receive",
+        "Duplicate", "Drop", "AddNewServer", "DeleteServer"))
+
+    def supports_incremental(self) -> bool:
+        """Parent-table memory is O(P * n_pos * B); the big-symmetry
+        configs (S=5 -> P=120) blow past the win, and their direct
+        salt-permutation path already measured >=1.0x vs native."""
+        return len(self.sigmas) <= 24
+
+    def _offsets(self):
+        S, Lcap = self.lay.S, self.lay.Lcap
+        return dict(ct=0, st=S, vf=2 * S, ci=3 * S, llen=4 * S,
+                    log=5 * S, vr=5 * S + S * Lcap,
+                    vg=6 * S + S * Lcap, ni=7 * S + S * Lcap,
+                    mi=7 * S + S * Lcap + S * S)
+
+    def _perm_mask_P(self, m, sig):
+        """m [cap] -> [P, cap]: perm_mask under every sigma at once."""
+        out = jnp.zeros((sig.shape[0],) + m.shape, jnp.int32)
+        for i in range(self.lay.S):
+            out = out | (((m >> i) & 1)[None] << sig[:, i][:, None])
+        return out
+
+    def parent_tables(self, svT: Dict) -> Dict:
+        """Batch-last parent rows [..., B] -> per-term tables:
+        posterm [P,T,n_pos,B], bagterm [P,T,K,B], h [P,T,B].  The same
+        arithmetic as _core, with the per-term sums retained."""
+        lay, kern = self.lay, self.kern
+        S, Lcap, K = lay.S, lay.Lcap, lay.K
+        hs = lay.header_shifts
+        bag = svT["bag"]                                  # [K, MW, B]
+        w0 = bag[:, 0]
+        mtype = get_field(w0, hs["mtype"]).astype(jnp.int32)
+        src = get_field(w0, hs["msrc"]).astype(jnp.int32)
+        dst = get_field(w0, hs["mdst"]).astype(jnp.int32)
+        braw = get_field(w0, hs["b"]).astype(jnp.int32)
+        clear = U32(0xFFFFFFFF) ^ U32(
+            put_field(0xFFFFFFFF, hs["msrc"]) |
+            put_field(0xFFFFFFFF, hs["mdst"]) |
+            put_field(0xFFFFFFFF, hs["b"]))
+        w0_base = w0 & clear
+        empty = mtype == 0
+        is_coc = mtype == MT_COC
+        ebits, epw = lay.entry_bits, lay.entries_per_word
+        emask = (1 << ebits) - 1
+        ent = jnp.stack([
+            ((bag[:, 1 + k // epw] >> (ebits * (k % epw))) & emask)
+            .astype(jnp.int32)
+            for k in range(lay.Lmax)], axis=1) if lay.msg_words > 1 \
+            else jnp.zeros((K, 0) + w0.shape[1:], jnp.int32)
+        vmask = (1 << lay.value_bits) - 1
+
+        def split_cfg(e):
+            is_cfg = (kern.entry_type(e) == CONFIG_ENTRY) & (e != 0)
+            return is_cfg, e & ~jnp.int32(vmask), e & vmask
+
+        ent_cfg, ent_base, ent_pay = split_cfg(ent)
+        log = svT["log"]
+        log_cfg, log_base, log_pay = split_cfg(log)
+        vf = svT["vf"]
+        cnt = svT["cnt"].astype(U32)
+        const_flat = [svT["ct"], svT["st"], None, svT["ci"], svT["llen"],
+                      None, None, None, svT["ni"], svT["mi"]]
+
+        def one_perm(sigma, psalt):
+            vfp = jnp.where(vf >= 0,
+                            sigma[jnp.clip(vf, 0, S - 1)], NIL)
+            vrp = self._perm_mask(svT["vr"], sigma)
+            vgp = self._perm_mask(svT["vg"], sigma)
+            logp = jnp.where(log_cfg,
+                             log_base | self._perm_mask(log_pay, sigma),
+                             log)
+            pieces = list(const_flat)
+            pieces[2], pieces[5], pieces[6], pieces[7] = vfp, logp, vrp, vgp
+            flat = jnp.concatenate(
+                [p.reshape((-1,) + p.shape[p.ndim - 1:]).astype(U32)
+                 for p in pieces])                        # [n_pos, B]
+            srcp = sigma[jnp.clip(src, 0, S - 1)]
+            dstp = sigma[jnp.clip(dst, 0, S - 1)]
+            bp = jnp.where(is_coc,
+                           sigma[jnp.clip(braw - 1, 0, S - 1)] + 1, braw)
+            w0p = (w0_base |
+                   put_field(srcp.astype(U32), hs["msrc"]) |
+                   put_field(dstp.astype(U32), hs["mdst"]) |
+                   put_field(bp.astype(U32), hs["b"]))
+            w0p = jnp.where(empty, w0, w0p)
+            entp = jnp.where(ent_cfg,
+                             ent_base | self._perm_mask(ent_pay, sigma),
+                             ent)
+            words = [w0p]
+            for w in range(1, lay.msg_words):
+                acc = jnp.zeros_like(w0)
+                for k in range((w - 1) * epw, min(w * epw, lay.Lmax)):
+                    acc = acc | (entp[:, k].astype(U32)
+                                 << (ebits * (k % epw)))
+                words.append(jnp.where(empty, bag[:, w], acc))
+            posterm, bagterm, hsum = [], [], []
+            for t in range(self.n_streams):
+                pt = fmix32(flat ^ psalt[t][:, None])     # [n_pos, B]
+                bs = jnp.asarray(self.bag_salts[t])
+                slot = jnp.zeros_like(w0)
+                for w in range(lay.msg_words):
+                    slot = slot + fmix32(words[w] ^ bs[w])
+                bt = cnt * fmix32(slot ^ bs[-1])          # [K, B]
+                posterm.append(pt)
+                bagterm.append(bt)
+                hsum.append(pt.sum(axis=0) + bt.sum(axis=0))
+            return (jnp.stack(posterm), jnp.stack(bagterm),
+                    jnp.stack(hsum))
+
+        posterm, bagterm, h = jax.vmap(one_perm)(
+            jnp.asarray(self.sigmas), jnp.asarray(self.psalts))
+        return dict(posterm=posterm, bagterm=bagterm, h=h)
+
+    def _slot_terms(self, words, cnt, sig):
+        """One bag slot per candidate (words [MW, cap] u32, cnt [cap])
+        -> its per-(perm, stream) bag term [P, T, cap]: the single-slot
+        twin of parent_tables' bag reduction."""
+        lay = self.lay
+        hs = lay.header_shifts
+        S = lay.S
+        w0 = words[0]
+        mtype = get_field(w0, hs["mtype"]).astype(jnp.int32)
+        src = get_field(w0, hs["msrc"]).astype(jnp.int32)
+        dst = get_field(w0, hs["mdst"]).astype(jnp.int32)
+        braw = get_field(w0, hs["b"]).astype(jnp.int32)
+        clear = U32(0xFFFFFFFF) ^ U32(
+            put_field(0xFFFFFFFF, hs["msrc"]) |
+            put_field(0xFFFFFFFF, hs["mdst"]) |
+            put_field(0xFFFFFFFF, hs["b"]))
+        w0_base = w0 & clear
+        empty = mtype == 0
+        is_coc = mtype == MT_COC
+        ebits, epw = lay.entry_bits, lay.entries_per_word
+        emask = (1 << ebits) - 1
+        vmask = (1 << lay.value_bits) - 1
+        srcp = sig[:, jnp.clip(src, 0, S - 1)]            # [P, cap]
+        dstp = sig[:, jnp.clip(dst, 0, S - 1)]
+        bp = jnp.where(is_coc[None],
+                       sig[:, jnp.clip(braw - 1, 0, S - 1)] + 1,
+                       braw[None])
+        w0p = (w0_base[None] |
+               put_field(srcp.astype(U32), hs["msrc"]) |
+               put_field(dstp.astype(U32), hs["mdst"]) |
+               put_field(bp.astype(U32), hs["b"]))
+        w0p = jnp.where(empty[None], w0[None], w0p)       # [P, cap]
+        wordsp = [w0p]
+        if lay.msg_words > 1:
+            ent = [((words[1 + k // epw] >> (ebits * (k % epw))) & emask)
+                   .astype(jnp.int32) for k in range(lay.Lmax)]
+            for w in range(1, lay.msg_words):
+                acc = jnp.zeros_like(w0p)
+                for k in range((w - 1) * epw, min(w * epw, lay.Lmax)):
+                    e = ent[k]
+                    is_cfg = (self.kern.entry_type(e) == CONFIG_ENTRY) \
+                        & (e != 0)
+                    ep = jnp.where(is_cfg[None],
+                                   (e & ~jnp.int32(vmask))[None] |
+                                   self._perm_mask_P(e & vmask, sig),
+                                   e[None])
+                    acc = acc | (ep.astype(U32) << (ebits * (k % epw)))
+                wordsp.append(jnp.where(empty[None], words[w][None],
+                                        acc))
+        out = []
+        cntu = cnt.astype(U32)
+        for t in range(self.n_streams):
+            bs = jnp.asarray(self.bag_salts[t])
+            slot = jnp.zeros_like(w0p)
+            for w in range(lay.msg_words):
+                slot = slot + fmix32(wordsp[w] ^ bs[w])
+            out.append(cntu[None] * fmix32(slot ^ bs[-1]))
+        return jnp.stack(out, axis=1)                     # [P, T, cap]
+
+    def family_delta(self, name: str, tables: Dict, b_idx, parT: Dict,
+                     candT: Dict, params) -> jnp.ndarray:
+        """Per-candidate per-permutation hashes [P, T, cap] for one
+        action family's buffer rows: parent hash + touched-term deltas.
+        parT/candT are batch-last [..., cap]; b_idx maps rows to the
+        chunk's parent index (tables' B axis).  Touch supersets follow
+        ops/kernels.py's masked writes; unchanged positions cancel."""
+        lay = self.lay
+        S, Lcap, K = lay.S, lay.Lcap, lay.K
+        hs = lay.header_shifts
+        OFF = self._offsets()
+        cap = b_idx.shape[0]
+        r = jnp.arange(cap)
+        sig = jnp.asarray(self.sigmas)                    # [P, S]
+        psal = jnp.asarray(self.psalts)                   # [P, T, n_pos]
+
+        if name in ("UpdateTerm", "CocDiscard", "Receive",
+                    "Duplicate", "Drop"):
+            k = params[0]
+            w0 = parT["bag"][k, 0, r]
+            i = get_field(w0, hs["mdst"]).astype(jnp.int32)
+            j = get_field(w0, hs["msrc"]).astype(jnp.int32)
+        else:
+            i = params[0]
+            j = params[1] if len(params) > 1 else None
+
+        touches = []                   # (kind, pos [cap], newval [cap])
+
+        def t_plain(key, a, pos):
+            touches.append(("plain", pos, candT[key][a, r]))
+
+        def t_mask(key, a, pos):
+            touches.append(("mask", pos, candT[key][a, r]))
+
+        if name == "Restart":
+            t_plain("st", i, OFF["st"] + i)
+            t_mask("vr", i, OFF["vr"] + i)
+            t_mask("vg", i, OFF["vg"] + i)
+            t_plain("ci", i, OFF["ci"] + i)
+            for jj in range(S):
+                touches.append(("plain", OFF["ni"] + i * S + jj,
+                                candT["ni"][i, jj, r]))
+                touches.append(("plain", OFF["mi"] + i * S + jj,
+                                candT["mi"][i, jj, r]))
+        elif name == "Timeout":
+            t_plain("ct", i, OFF["ct"] + i)
+            t_plain("st", i, OFF["st"] + i)
+            touches.append(("vf", OFF["vf"] + i, candT["vf"][i, r]))
+            t_mask("vr", i, OFF["vr"] + i)
+            t_mask("vg", i, OFF["vg"] + i)
+        elif name == "BecomeLeader":
+            t_plain("st", i, OFF["st"] + i)
+            for jj in range(S):
+                touches.append(("plain", OFF["ni"] + i * S + jj,
+                                candT["ni"][i, jj, r]))
+                touches.append(("plain", OFF["mi"] + i * S + jj,
+                                candT["mi"][i, jj, r]))
+        elif name == "ClientRequest":
+            t_plain("llen", i, OFF["llen"] + i)
+            lpos = jnp.clip(parT["llen"][i, r], 0, Lcap - 1)
+            touches.append(("logent", OFF["log"] + i * Lcap + lpos,
+                            candT["log"][i, lpos, r]))
+        elif name == "AdvanceCommitIndex":
+            t_plain("ci", i, OFF["ci"] + i)
+        elif name == "AddNewServer":
+            t_plain("ct", j, OFF["ct"] + j)
+            touches.append(("vf", OFF["vf"] + j, candT["vf"][j, r]))
+        elif name == "UpdateTerm":
+            t_plain("ct", i, OFF["ct"] + i)
+            t_plain("st", i, OFF["st"] + i)
+            touches.append(("vf", OFF["vf"] + i, candT["vf"][i, r]))
+        elif name == "Receive":
+            t_plain("ct", i, OFF["ct"] + i)
+            t_plain("st", i, OFF["st"] + i)
+            touches.append(("vf", OFF["vf"] + i, candT["vf"][i, r]))
+            t_plain("ci", i, OFF["ci"] + i)
+            t_plain("llen", i, OFF["llen"] + i)
+            t_mask("vr", i, OFF["vr"] + i)
+            t_mask("vg", i, OFF["vg"] + i)
+            jc = jnp.clip(j, 0, S - 1)
+            touches.append(("plain", OFF["ni"] + i * S + jc,
+                            candT["ni"][i, jc, r]))
+            touches.append(("plain", OFF["mi"] + i * S + jc,
+                            candT["mi"][i, jc, r]))
+            for ll in range(Lcap):
+                touches.append(("logent", OFF["log"] + i * Lcap + ll,
+                                candT["log"][i, ll, r]))
+        # RequestVote / AppendEntries / DeleteServer / CocDiscard /
+        # Duplicate / Drop: bag-only
+
+        vmask = (1 << lay.value_bits) - 1
+        delta = jnp.zeros((len(self.sigmas), self.n_streams, cap), U32)
+        for kind, pos, val in touches:
+            pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (cap,))
+            old = tables["posterm"][:, :, pos, b_idx]     # [P, T, cap]
+            saltv = psal[:, :, pos]                       # [P, T, cap]
+            if kind == "plain":
+                newv = jnp.broadcast_to(val.astype(U32)[None],
+                                        (len(self.sigmas), cap))
+            elif kind == "vf":
+                newv = jnp.where(val[None] >= 0,
+                                 sig[:, jnp.clip(val, 0, S - 1)],
+                                 NIL).astype(U32)
+            elif kind == "mask":
+                newv = self._perm_mask_P(val, sig).astype(U32)
+            else:                                         # logent
+                is_cfg = (self.kern.entry_type(val) == CONFIG_ENTRY) \
+                    & (val != 0)
+                newv = jnp.where(
+                    is_cfg[None],
+                    (val & ~jnp.int32(vmask))[None] |
+                    self._perm_mask_P(val & vmask, sig),
+                    val[None]).astype(U32)
+            delta = delta + (fmix32(newv[:, None] ^ saltv) - old)
+
+        if name in self._BAG_FAMILIES:
+            bagp = parT["bag"]                            # [K, MW, cap]
+            bagc = candT["bag"]
+            diff = jnp.any(bagp != bagc, axis=1) | \
+                (parT["cnt"] != candT["cnt"])             # [K, cap]
+            k0 = jnp.argmax(diff, axis=0)
+            d0 = diff[k0, r]
+            diff2 = diff & (jnp.arange(K)[:, None] != k0[None])
+            k1 = jnp.argmax(diff2, axis=0)
+            d1 = diff2[k1, r]
+            bag_t = jnp.moveaxis(bagc, 1, 0)              # [MW, K, cap]
+            for km, dm in ((k0, d0), (k1, d1)):
+                old = tables["bagterm"][:, :, km, b_idx]
+                new = self._slot_terms(bag_t[:, km, r],
+                                       candT["cnt"][km, r], sig)
+                delta = delta + jnp.where(dm[None, None], new - old, 0)
+
+        return tables["h"][:, :, b_idx] + delta
+
+    def finish_min(self, h_all) -> jnp.ndarray:
+        """[P, T, ...] per-perm hashes -> sealed canonical fingerprint
+        [T, ...] (same lexmin + sentinel remap as the direct path)."""
+        return self._seal(self._lex_min(h_all))
 
 
 # canonical dedup-key bit layout lives in utils (host helpers);

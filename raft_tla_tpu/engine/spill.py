@@ -1,0 +1,579 @@
+"""Host-spill BFS engine: levels stream through host RAM, breaking the
+single-chip HBM exhaustion wall (SURVEY §7.2 L4 "spill/compact to host";
+VERDICT r3 #1).
+
+The classic Engine (engine/bfs) keeps the frontier and the level buffer
+device-resident, which caps level-exact runs at the deepest level whose
+~340 B/state buffers fit HBM next to the visited table (measured:
+depth 19 on BASELINE config #2, depth 21 on #1 — BASELINE.md
+"exhaustion wall").  TLC never has this wall: its fingerprint set and
+state queue spill to disk (`states/`, /root/reference/.gitignore:4).
+
+This engine is the TPU counterpart, shaped by the tunneled-runtime's
+transfer economics (big transfers amortize the ~100 ms round trip;
+per-chunk scalar syncs do not):
+
+- HBM holds ONLY the visited table (12 B/key at fp64 — the one
+  structure whose random-access probes need device residency) plus two
+  SEGMENT buffers: a frontier segment being expanded and a level
+  segment being filled.
+- The frontier lives in host RAM as a list of narrow batch-last
+  blocks; segments upload whole (one big H2D per ~SEG states).
+- Fresh states append to the level segment on device; when it fills
+  (or the level ends) it spills whole to the host (one big D2H),
+  becoming both the next-frontier source and the trace archive.
+- The host syncs ONE small summary vector every `sync_every` chunks
+  (not per chunk): JAX only transfers what is forced, so the
+  intermediate summaries are never fetched.
+
+Overflow recovery is CHUNK-local (the classic engine's whole-level
+journal replay is impossible once earlier segments have spilled): a
+chunk that trips any overflow — level segment full (ovf), family/
+compaction caps (fovf), probe-round budget (hovf) — reverts its own
+table inserts in-step and leaves no trace; every later chunk in the
+sync window sees the sticky flag and does nothing.  The host then
+fixes the cause (spill the segment / grow caps / grow+rehash the
+table), resets the flags, and resumes from the recorded trip chunk —
+enumeration order is exactly preserved, so counts and first-seen
+survivors match the classic engine and the oracle bit-for-bit.
+
+Constraint semantics stay prune-not-expand (SURVEY §2.8): pruned rows
+are counted, invariant-checked and archived, then dropped on host when
+the next frontier is assembled (the classic engine keeps them device-
+side under an fmask instead — same reachable set, differentially
+tested in tests/test_spill.py).
+
+What this buys: the depth wall moves from "level buffers fit HBM"
+(~8.5 GB at depth 20 on config #2) to "visited table fits HBM" —
+~12 B/key lets ~400M distinct states on a 16 GB chip, with level
+buffers bounded by the 125 GB host.  The native C++ checker OOMs the
+same host at ~65 GB RSS (~650 B/state) long before that — BASELINE.md
+round-4 records the beyond-the-wall rows this engine produced.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import ModelConfig
+from ..models.raft import init_state
+from ..ops.codec import C_OVERFLOW, decode, encode, narrow, widen
+from .bfs import CheckResult, Engine, U32MAX, Violation
+
+# summary vector layout (int32): the per-window device->host sync
+S_NLVL, S_NGEN, S_OVF, S_FOVF, S_HOVF, S_TRIP, S_LEN = range(7)
+
+
+class SpillEngine(Engine):
+    """Engine whose frontier/level buffers stream through host RAM.
+
+    chunk      — frontier states expanded per fused device call.
+    seg        — level/frontier segment capacity (states); HBM holds
+                 ~2 segments x ~340 B/state next to the visited table.
+    vcap       — initial visited-table slots (grows by device rehash).
+    sync_every — chunks between summary syncs (each sync costs one
+                 tunneled round trip; a trip replays at most this many
+                 chunks).
+    """
+
+    def __init__(self, cfg: ModelConfig, chunk: int = 2048,
+                 store_states: bool = False, seg: int = 1 << 21,
+                 vcap: int = 1 << 22, fcap: Optional[int] = None,
+                 sync_every: int = 8):
+        super().__init__(cfg, chunk=chunk, store_states=store_states,
+                         lcap=seg, vcap=vcap, fcap=fcap)
+        self.SEGL = self.LCAP          # level segment rows (can grow)
+        self.SEGF = self.LCAP          # frontier segment rows (fixed)
+        self.sync_every = max(1, int(sync_every))
+        self._sstep_jit = jax.jit(self._spill_step_impl,
+                                  donate_argnums=0, static_argnums=1)
+
+    # ------------------------------------------------------------------
+    # fused per-chunk step (spill twin of Engine._chunk_step_impl)
+    # ------------------------------------------------------------------
+
+    def _spill_step_impl(self, carry, fam_caps):
+        """One frontier chunk: expand + fingerprint (shared front half
+        _expand_fp_chunk) + claim-insert dedup + invariant/constraint
+        eval + append to the level segment.  Returns (carry', summary).
+
+        Chunk-local overflow discipline (module docstring): a chunk
+        that trips ovf/fovf/hovf reverts its own inserts and commits
+        nothing; `trip_base` records the first tripping chunk's frontier
+        cursor so the host can resume exactly there after fixing."""
+        B, A, W = self.chunk, self.A, self.W
+        SEGL = carry["lpar"].shape[0]
+        FCAP = carry["cidx"].shape[0]
+        VCAP = carry["vis"][0].shape[0]
+        base = carry["base"]
+        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B,
+                                                axis=v.ndim - 1)
+                    for k, v in carry["front"].items()})
+        # no fmask: constraint-pruned rows never enter the frontier
+        # (host compacts them away — prune-not-expand is host-side)
+        valid = (base + jnp.arange(B, dtype=jnp.int32)) < carry["n_front"]
+        cand_c, elive, fp, take, famx_c, n_e = self._expand_fp_chunk(
+            sv, valid, fam_caps, FCAP)
+        famx = jnp.maximum(carry["famx"], famx_c)
+        fovf_now = (n_e > FCAP) | \
+            jnp.any(famx_c > jnp.asarray(fam_caps, jnp.int32))
+        gate = ~(carry["ovf"] | carry["fovf"] | carry["hovf"])
+        live = elive & gate & ~fovf_now
+
+        keys = tuple(jnp.where(live, fp[w], U32MAX) for w in range(W))
+        ranks = jnp.arange(FCAP, dtype=jnp.uint32)
+        table, claims, fresh, pos, hovf_now = self._probe_insert(
+            carry["vis"], carry["claims"], keys, live, ranks)
+        n_fresh = fresh.sum(dtype=jnp.int32)
+        ovf_now = gate & (carry["n_lvl"] + n_fresh > SEGL - FCAP)
+        bad_now = gate & (fovf_now | hovf_now | ovf_now)
+        # revert THIS chunk's inserts on any trip — the chunk leaves no
+        # trace, so the host replay re-runs it bit-identically
+        ridx = jnp.where(fresh & bad_now, pos, VCAP)
+        table = tuple(table[w].at[ridx].set(U32MAX, mode="drop")
+                      for w in range(W))
+        fresh = fresh & ~bad_now
+        n_fresh = jnp.where(bad_now, 0, n_fresh)
+        commit = gate & ~bad_now
+        n_gen = carry["n_gen"] + \
+            jnp.where(commit, elive.sum(dtype=jnp.int32), 0)
+        trip_base = jnp.where(gate & bad_now, base, carry["trip_base"])
+
+        # contiguous append of the fresh rows (engine/bfs layout notes)
+        slot = jnp.arange(FCAP, dtype=jnp.int32)
+        lpos = jnp.where(fresh,
+                         jnp.cumsum(fresh.astype(jnp.int32)) - 1, FCAP)
+        lidx = lax.optimization_barrier(
+            jnp.zeros((FCAP,), jnp.int32).at[lpos].set(
+                slot, mode="drop"))
+        start = jnp.minimum(carry["n_lvl"], SEGL - FCAP)
+        lane = take[lidx]
+        rows = lax.optimization_barrier(
+            {k: cand_c[k][..., lidx] for k in cand_c})
+        inv, con = lax.optimization_barrier(self._phase2_T(rows))
+        rows_n = narrow(self.lay, rows)
+        lvl = {k: lax.dynamic_update_slice_in_dim(
+                   v, rows_n[k], start, v.ndim - 1)
+               for k, v in carry["lvl"].items()}
+        # parent ids come from the uploaded per-row global ids (the
+        # host-compacted frontier breaks the classic engine's
+        # pg_off+row arithmetic)
+        lpar = lax.dynamic_update_slice_in_dim(
+            carry["lpar"], carry["gids"][base + lane // A], start, 0)
+        llane = lax.dynamic_update_slice_in_dim(
+            carry["llane"], lane % A, start, 0)
+        linv = lax.dynamic_update_slice_in_dim(carry["linv"], inv,
+                                               start, 1)
+        lcon = lax.dynamic_update_slice_in_dim(
+            carry["lcon"], con, start, 0)
+        n_lvl = jnp.minimum(carry["n_lvl"] + n_fresh, SEGL - FCAP)
+        ovf = carry["ovf"] | ovf_now
+        fovf = carry["fovf"] | (gate & fovf_now)
+        hovf = carry["hovf"] | (gate & hovf_now)
+        summary = jnp.concatenate([jnp.stack([
+            n_lvl, n_gen, ovf.astype(jnp.int32), fovf.astype(jnp.int32),
+            hovf.astype(jnp.int32), trip_base]), famx])
+        new_carry = dict(carry, vis=table, claims=claims, lvl=lvl,
+                         lpar=lpar, llane=llane, linv=linv, lcon=lcon,
+                         n_lvl=n_lvl, n_gen=n_gen, famx=famx, ovf=ovf,
+                         fovf=fovf, hovf=hovf, trip_base=trip_base,
+                         base=base + B)
+        return new_carry, summary
+
+    # ------------------------------------------------------------------
+
+    def _fresh_spill_carry(self):
+        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
+        lvl = {k: jnp.zeros(v.shape + (self.SEGL,), dtype=v.dtype)
+               for k, v in one.items()}
+        front = {k: jnp.zeros(v.shape + (self.SEGF,), dtype=v.dtype)
+                 for k, v in one.items()}
+        n_inv = len(self.inv_names)
+        return dict(
+            vis=tuple(jnp.full((self.VCAP,), U32MAX)
+                      for _ in range(self.W)),
+            claims=jnp.full((self.VCAP,), U32MAX),
+            lvl=lvl,
+            lpar=jnp.full((self.SEGL,), -1, jnp.int32),
+            llane=jnp.full((self.SEGL,), -1, jnp.int32),
+            linv=jnp.ones((n_inv, self.SEGL), bool),
+            lcon=jnp.ones((self.SEGL,), bool),
+            front=front,
+            gids=jnp.full((self.SEGF,), -1, jnp.int32),
+            cidx=jnp.zeros((self.FCAP,), jnp.int32),  # FCAP anchor
+            n_front=jnp.int32(0),
+            base=jnp.int32(0),
+            n_lvl=jnp.int32(0),
+            n_gen=jnp.int32(0),
+            famx=jnp.zeros((len(self.expander.families),), jnp.int32),
+            ovf=jnp.bool_(False),
+            fovf=jnp.bool_(False),
+            hovf=jnp.bool_(False),
+            trip_base=jnp.int32(-1),
+        )
+
+    def _reset_lvl_buffers(self, carry):
+        """Fresh level-segment buffers at the CURRENT self.SEGL/FCAP
+        (used after a cap growth changed shapes; plain n_lvl reset
+        suffices otherwise)."""
+        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
+        carry["lvl"] = {k: jnp.zeros(v.shape + (self.SEGL,),
+                                     dtype=v.dtype)
+                        for k, v in one.items()}
+        carry["lpar"] = jnp.full((self.SEGL,), -1, jnp.int32)
+        carry["llane"] = jnp.full((self.SEGL,), -1, jnp.int32)
+        carry["linv"] = jnp.ones((len(self.inv_names), self.SEGL), bool)
+        carry["lcon"] = jnp.ones((self.SEGL,), bool)
+        carry["cidx"] = jnp.zeros((self.FCAP,), jnp.int32)
+        carry["n_lvl"] = jnp.int32(0)
+        return carry
+
+    # ------------------------------------------------------------------
+    # host-side level plumbing
+    # ------------------------------------------------------------------
+
+    def _spill_segment(self, carry, n_lvl: int):
+        """Fetch the filled rows of the level segment (ONE big D2H per
+        array) and reset the device cursor.  Blocks stay narrow and
+        batch-LAST — the exact layout the next upload needs."""
+        blk = None
+        if n_lvl:
+            blk = dict(
+                rows={k: np.asarray(v[..., :n_lvl])
+                      for k, v in carry["lvl"].items()},
+                lpar=np.asarray(carry["lpar"][:n_lvl]),
+                llane=np.asarray(carry["llane"][:n_lvl]),
+                linv=np.asarray(carry["linv"][:, :n_lvl]),
+                lcon=np.asarray(carry["lcon"][:n_lvl]),
+                n=n_lvl)
+        carry["n_lvl"] = jnp.int32(0)
+        return carry, blk
+
+    def _upload_segment(self, carry, seg_rows: Dict[str, np.ndarray],
+                        seg_gids: np.ndarray):
+        """ONE big H2D per array: pad the frontier segment to SEGF and
+        swap it into the carry (old buffers free under donation)."""
+        n = int(seg_gids.shape[0])
+        pad = self.SEGF - n
+        front = {}
+        for k, v in seg_rows.items():
+            if pad:
+                v = np.concatenate(
+                    [v, np.zeros(v.shape[:-1] + (pad,), v.dtype)],
+                    axis=-1)
+            front[k] = jnp.asarray(v)
+        gids = np.full((self.SEGF,), -1, np.int32)
+        gids[:n] = seg_gids
+        carry["front"] = front
+        carry["gids"] = jnp.asarray(gids)
+        carry["n_front"] = jnp.int32(n)
+        carry["base"] = jnp.int32(0)
+        return carry
+
+    @staticmethod
+    def _resegment(blocks: List, seg: int):
+        """Yield (rows, gids) segments of <= seg rows from frontier
+        blocks [(rows dict batch-last, gids)], concatenating across
+        block boundaries."""
+        buf_rows, buf_gids, have = [], [], 0
+        for rows, gids in blocks:
+            n = int(gids.shape[0])
+            off = 0
+            while off < n:
+                take_n = min(seg - have, n - off)
+                buf_rows.append({k: v[..., off:off + take_n]
+                                 for k, v in rows.items()})
+                buf_gids.append(gids[off:off + take_n])
+                have += take_n
+                off += take_n
+                if have == seg:
+                    yield SpillEngine._cat_seg(buf_rows, buf_gids)
+                    buf_rows, buf_gids, have = [], [], 0
+        if have:
+            yield SpillEngine._cat_seg(buf_rows, buf_gids)
+
+    @staticmethod
+    def _cat_seg(buf_rows, buf_gids):
+        if len(buf_rows) == 1:
+            return buf_rows[0], buf_gids[0]
+        keys = buf_rows[0].keys()
+        return ({k: np.concatenate([b[k] for b in buf_rows], axis=-1)
+                 for k in keys}, np.concatenate(buf_gids))
+
+    # ------------------------------------------------------------------
+
+    def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
+              stop_on_violation: bool = False,
+              seed_states: Optional[List] = None,
+              checkpoint_path: Optional[str] = None,
+              checkpoint_every: int = 1,
+              resume_from: Optional[str] = None,
+              verbose: bool = False) -> CheckResult:
+        if checkpoint_path is not None or resume_from is not None:
+            raise NotImplementedError(
+                "SpillEngine does not checkpoint yet — its wavefront "
+                "lives in host blocks; use the classic Engine for "
+                "checkpointed runs within its depth range")
+        t0 = time.time()
+        lay = self.lay
+        self._states: List[Dict[str, np.ndarray]] = []
+        self._parents: List[np.ndarray] = []
+        self._lanes: List[np.ndarray] = []
+
+        # ---- roots (shared admit path: engine/bfs._dedup_roots) ------
+        roots, rk, pin_interiors = self._dedup_roots(seed_states)
+        n_roots = len(rk)
+
+        res = CheckResult(distinct_states=0, generated_states=n_roots,
+                          depth=0)
+        self._check_pin_interiors(pin_interiors, res)
+
+        carry = self._fresh_spill_carry()
+        slots = self._host_probe_assign(rk, vcap=self.VCAP)
+        sl = jnp.asarray(slots)
+        carry["vis"] = tuple(
+            carry["vis"][w].at[sl].set(jnp.asarray(rk[:, w]))
+            for w in range(self.W))
+        inv_r, con_r = (np.asarray(a) for a in self._phase2(
+            {k: jnp.asarray(v) for k, v in roots.items()}))
+        roots_T = {k: np.moveaxis(v, 0, -1)
+                   for k, v in narrow(lay, roots).items()}
+        root_blk = dict(rows=roots_T,
+                        lpar=np.full((n_roots,), -1, np.int32),
+                        llane=np.full((n_roots,), -1, np.int32),
+                        linv=inv_r.T, lcon=con_r, n=n_roots)
+
+        n_states = 0       # running global id offset
+        n_vis = n_roots
+        gen_committed = 0  # device n_gen is monotone; track the delta
+        depth = 0
+        frontier_blocks: List = []
+
+        def harvest_block(blk):
+            """Counts, violations, archives, next-frontier rows for one
+            spilled block; returns (rows, gids) for the frontier."""
+            nonlocal n_states
+            n = blk["n"]
+            res.distinct_states += n
+            # C_OVERFLOW representability faults (engine/bfs finalize
+            # counts the same lane per level)
+            res.overflow_faults += int(
+                (blk["rows"]["ctr"][C_OVERFLOW] > 0).sum())
+            gids = np.arange(n_states, n_states + n, dtype=np.int32)
+            inv_ok = blk["linv"]
+            if inv_ok.size and not inv_ok.all():
+                bad = np.nonzero(~inv_ok)
+                res.violations_global += len(bad[0])
+                for j, s in zip(*bad):
+                    vsv, vh = decode(lay, _take_last(blk["rows"], s))
+                    res.violations.append(Violation(
+                        self.inv_names[j], int(gids[s]),
+                        state=vsv, hist=vh))
+            if self.store_states:
+                self._lvl_parts[-1].append(blk)
+            n_states += n
+            if n_states >= 2 ** 31 - 1:
+                raise RuntimeError(
+                    "state-id space exhausted (2^31 ids): run exceeds "
+                    "the engine's int32 global-id width")
+            con = blk["lcon"].astype(bool)
+            if con.all():
+                return blk["rows"], gids
+            keep = np.nonzero(con)[0]
+            if not len(keep):
+                return None
+            return ({k: v[..., keep] for k, v in blk["rows"].items()},
+                    gids[keep])
+
+        def _take_last(rows, i):
+            return {k: np.asarray(v[..., i]) for k, v in rows.items()}
+
+        def flush_archives():
+            """store_states: merge this level's spilled parts into the
+            classic batch-major per-level archive (trace()/get_state
+            are inherited unchanged)."""
+            if not self.store_states:
+                return
+            parts = self._lvl_parts[-1]
+            if not parts:
+                return
+            self._parents.append(np.concatenate(
+                [p["lpar"] for p in parts]))
+            self._lanes.append(np.concatenate(
+                [p["llane"] for p in parts]))
+            keys = parts[0]["rows"].keys()
+            self._states.append(
+                {k: np.moveaxis(np.concatenate(
+                    [p["rows"][k] for p in parts], axis=-1), -1, 0)
+                 for k in keys})
+
+        self._lvl_parts: List[List] = [[]]
+        out = harvest_block(root_blk)
+        flush_archives()
+        if out is not None:
+            frontier_blocks.append(out)
+        res.generated_states = n_roots
+        if stop_on_violation and res.violations:
+            res.seconds = time.time() - t0
+            return res
+
+        # ---- level loop ---------------------------------------------
+        while frontier_blocks and depth < max_depth and \
+                res.distinct_states < max_states:
+            depth += 1
+            t1 = time.time()
+            self._lvl_parts.append([])
+            level_new = 0
+            gen_before = gen_committed
+            next_blocks: List = []
+
+            for seg_rows, seg_gids in self._resegment(
+                    frontier_blocks, self.SEGF):
+                carry = self._grow_table_if_needed(carry, n_vis)
+                carry = self._upload_segment(carry, seg_rows, seg_gids)
+                n_seg = int(seg_gids.shape[0])
+                n_chunks = (n_seg + self.chunk - 1) // self.chunk
+                k = 0
+                while k < n_chunks:
+                    # re-derived each window: a fovf trip may have
+                    # grown FCAP/SEGL mid-segment
+                    spill_floor = self.SEGL - self.FCAP * (
+                        self.sync_every + 2)
+                    win_end = min(k + self.sync_every, n_chunks)
+                    summ = None
+                    while k < win_end:
+                        carry, summ = self._sstep_jit(carry,
+                                                      self.FAM_CAPS)
+                        k += 1
+                    s = np.asarray(summ)        # the ONE window sync
+                    if s[S_OVF] or s[S_FOVF] or s[S_HOVF]:
+                        carry, blk, k = self._handle_trip(
+                            carry, s, n_vis, verbose)
+                        if blk is not None:
+                            n_vis += blk["n"]
+                            level_new += blk["n"]
+                            out = harvest_block(blk)
+                            if out is not None:
+                                next_blocks.append(out)
+                    elif int(s[S_NLVL]) >= spill_floor:
+                        carry, blk = self._spill_segment(
+                            carry, int(s[S_NLVL]))
+                        if blk is not None:
+                            n_vis += blk["n"]
+                            level_new += blk["n"]
+                            out = harvest_block(blk)
+                            if out is not None:
+                                next_blocks.append(out)
+                gen_committed = int(np.asarray(carry["n_gen"]))
+                # final spill for this segment epoch happens lazily —
+                # rows stay on device and keep accumulating across
+                # frontier segments until the floor trips or the level
+                # ends (fewer, larger transfers)
+
+            # level end: spill the remainder
+            n_rem = int(np.asarray(carry["n_lvl"]))
+            carry, blk = self._spill_segment(carry, n_rem)
+            if blk is not None:
+                n_vis += blk["n"]
+                level_new += blk["n"]
+                out = harvest_block(blk)
+                if out is not None:
+                    next_blocks.append(out)
+            gen_committed = int(np.asarray(carry["n_gen"]))
+            flush_archives()
+            res.generated_states += gen_committed - gen_before
+            if level_new == 0 and gen_committed == gen_before:
+                # pruned-only frontier cannot occur here (host drops
+                # pruned rows), but an empty-frontier guard keeps the
+                # depth semantics aligned with engine/bfs
+                depth -= 1
+            else:
+                res.level_sizes.append(
+                    sum(int(g.shape[0]) for _r, g in next_blocks))
+            frontier_blocks = next_blocks   # the expanded level's
+            # blocks are freed here (rebind) unless archived
+            if stop_on_violation and res.violations:
+                break
+            if verbose:
+                print(f"depth {depth}: +{level_new} states "
+                      f"(total {res.distinct_states}), "
+                      f"frontier {sum(int(g.shape[0]) for _r, g in frontier_blocks)}, "
+                      f"{time.time() - t1:.2f}s", flush=True)
+        res.depth = depth
+        res.seconds = time.time() - t0
+        return res
+
+    # ------------------------------------------------------------------
+
+    def _grow_table_if_needed(self, carry, n_vis: int):
+        """Between-segment load check: a segment epoch can add at most
+        SEGL - FCAP keys before its mandatory spill sync."""
+        need = n_vis + self.SEGL - self.FCAP
+        if need > self._LOAD_MAX * self.VCAP:
+            while need > self._LOAD_MAX * self.VCAP:
+                self.VCAP *= 4
+            vis, claims = self._rehash_tables(carry["vis"], self.VCAP)
+            carry = dict(carry, vis=vis, claims=claims)
+        return carry
+
+    def _handle_trip(self, carry, s, n_vis: int, verbose: bool):
+        """Fix whatever tripped (segment full / caps / table), reset
+        the sticky flags, and point the cursor back at the tripped
+        chunk.  The tripped chunk left no trace (step docstring), so
+        resuming there preserves enumeration order exactly."""
+        trip_base = int(s[S_TRIP])
+        assert trip_base >= 0, "trip flags set but no trip_base"
+        blk = None
+        if s[S_OVF]:
+            carry, blk = self._spill_segment(carry, int(s[S_NLVL]))
+        if s[S_FOVF]:
+            famx = [int(x) for x in s[S_LEN:S_LEN + len(self.FAM_CAPS)]]
+            caps = list(self.FAM_CAPS)
+            fam_over = False
+            for fi, fam in enumerate(self.expander.families):
+                hard = fam.n_lanes * self.chunk
+                while caps[fi] < hard and famx[fi] > caps[fi]:
+                    caps[fi] = min(2 * caps[fi], hard)
+                    fam_over = True
+            self.FAM_CAPS = tuple(caps)
+            old_shapes = (self.FCAP, self.SEGL)
+            if not fam_over:
+                self.FCAP = self._round_cap(min(
+                    self.chunk * self.A,
+                    max(2 * self.FCAP, (5 * int(sum(famx))) // 4)))
+            if self.SEGL < 4 * self.FCAP:
+                # the level segment keeps an FCAP-sized append margin
+                self.SEGL = self._round_cap(4 * self.FCAP)
+            if (self.FCAP, self.SEGL) != old_shapes:
+                # buffer shapes change: spill the committed rows FIRST
+                # (a reset would drop them), then rebuild
+                if blk is None:
+                    carry, blk = self._spill_segment(carry,
+                                                     int(s[S_NLVL]))
+                carry = self._reset_lvl_buffers(dict(carry))
+            # FAM_CAPS-only growth retraces via the static jit arg —
+            # no buffer rebuild needed
+        if s[S_HOVF]:
+            self.VCAP *= 4
+            vis, claims = self._rehash_tables(carry["vis"], self.VCAP)
+            carry = dict(carry, vis=vis, claims=claims)
+        if verbose:
+            print(f"trip at base {trip_base}: ovf={int(s[S_OVF])} "
+                  f"fovf={int(s[S_FOVF])} hovf={int(s[S_HOVF])} "
+                  f"-> FCAP={self.FCAP} SEGL={self.SEGL} "
+                  f"VCAP={self.VCAP} fam_caps={self.FAM_CAPS}",
+                  flush=True)
+        carry["ovf"] = jnp.bool_(False)
+        carry["fovf"] = jnp.bool_(False)
+        carry["hovf"] = jnp.bool_(False)
+        carry["trip_base"] = jnp.int32(-1)
+        carry["famx"] = jnp.zeros((len(self.expander.families),),
+                                  jnp.int32)
+        carry["base"] = jnp.int32(trip_base)
+        return carry, blk, trip_base // self.chunk

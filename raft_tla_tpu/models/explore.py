@@ -142,6 +142,9 @@ class ExploreResult:
     level_sizes: List[int] = field(default_factory=list)
     # key -> (State, Hist); only retained if keep_states=True
     states: Optional[Dict] = None
+    # distinct pinned-prefix interior states invariant-checked but not
+    # counted (TLC counts them; engine/bfs.CheckResult twin field)
+    pin_interior_states: int = 0
 
 
 def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
@@ -164,16 +167,32 @@ def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
             sv = canonicalize(sv, perms, cfg)
         return sv
 
+    pin_interiors = None
     if seed_states is None and cfg.prefix_pins:
         # cfg-declared punctuated-search pins compile to seeds
         # (raft.tla:1198-1234; models/golden docstring)
         from .golden import prefix_pin_seeds
-        seed_states = prefix_pin_seeds(cfg)
+        seed_states, pin_interiors = prefix_pin_seeds(
+            cfg, with_interior=True)
     roots = (seed_states if seed_states is not None
              else [init_state(cfg)])
     seen: Dict = {}
     parent: Dict = {}
     result = ExploreResult(distinct_states=0, generated_states=0, depth=0)
+    if pin_interiors:
+        # TLC counts + checks the prefix interior states; seeding at
+        # the witness end skips them — invariant-check them here and
+        # record the count divergence bound (models/golden docstring)
+        int_seen = set()
+        for sv, h in pin_interiors:
+            k = key_of(sv)
+            if k in int_seen:
+                continue
+            int_seen.add(k)
+            result.pin_interior_states += 1
+            for nm, fn in inv_fns:
+                if not fn(sv, h, cfg):
+                    result.violations.append(Violation(nm, sv, h))
 
     def check(sv, h, k):
         for nm, fn in inv_fns:
@@ -200,6 +219,12 @@ def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
             return result
         if all(f(sv0, h0, cfg) for f in con_fns):
             frontier.append((sv0, h0, k0))
+    if stop_on_violation and result.violations:
+        # a pinned-prefix interior state violated: stop after the root
+        # level, exactly like the engines (engine/bfs.check)
+        result.distinct_states = len(seen)
+        result.states = seen if keep_states else None
+        return result
     depth = 0
     while frontier and depth < max_depth and len(seen) < max_states:
         depth += 1

@@ -147,13 +147,19 @@ def replay(labels: List[str], cfg: ModelConfig, start=None):
     return states
 
 
-def prefix_pin_seeds(cfg: ModelConfig) -> List[Tuple]:
+def prefix_pin_seeds(cfg: ModelConfig, with_interior: bool = False):
     """cfg.prefix_pins -> BFS seed states (oracle (State, Hist) pairs),
     or None when the cfg has no pins.  Multiple pins resolve to the
     longest witness (the 28-record trace extends the 20-record one, so
-    the conjunction of both constraints IS the longer prefix)."""
+    the conjunction of both constraints IS the longer prefix).
+
+    with_interior=True additionally returns the replayed prefix
+    *interior* states (everything before each witness end, including
+    Init) so callers can invariant-check them and report the
+    distinct-state divergence from TLC — TLC counts and checks those
+    states; seeding at the end skips them (module docstring)."""
     if not cfg.prefix_pins:
-        return None
+        return (None, None) if with_interior else None
     for nm in cfg.prefix_pins:
         if nm not in PIN_LABELS:
             raise KeyError(f"unknown prefix pin {nm!r}")
@@ -167,7 +173,9 @@ def prefix_pin_seeds(cfg: ModelConfig) -> List[Tuple]:
     else:
         assigns = list(itertools.permutations(range(cfg.n_servers), 3))
     seeds = []
+    interiors = []
     for a in assigns:
-        seeds.append(replay([relabel_label(l, a) for l in labels],
-                            cfg)[-1])
-    return seeds
+        states = replay([relabel_label(l, a) for l in labels], cfg)
+        seeds.append(states[-1])
+        interiors.extend(states[:-1])
+    return (seeds, interiors) if with_interior else seeds

@@ -410,28 +410,12 @@ class ShardedEngine(Engine):
             n_front = meta["n_front"]
             resumed = True
         else:
-            if seed_states is None and self.cfg.prefix_pins:
-                from ..models.golden import prefix_pin_seeds
-                seed_states = prefix_pin_seeds(self.cfg)
-            init_list = (seed_states if seed_states is not None
-                         else [init_state(self.cfg)])
-            init_arrs = widen(_cat([
-                {k: np.asarray(v)[None] for k, v in s.items()}
-                if isinstance(s, dict) else
-                {k: v[None] for k, v in encode(lay, *s).items()}
-                for s in init_list]))
-            rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
-            root_fp = np.asarray(
-                self._rootfp_jit(rootsb)).astype(np.uint32)
-            # host-side dedup of seeds + ownership routing
-            keys = [tuple(int(root_fp[i, w]) for w in range(W))
-                    for i in range(root_fp.shape[0])]
-            seen = {}
-            for i, k in enumerate(keys):
-                seen.setdefault(k, i)
+            # shared root admission (engine/bfs._dedup_roots), then
+            # this engine's extra step: hash-ownership routing
+            roots, rk, pin_interiors = self._dedup_roots(seed_states)
             per_dev: List[List[int]] = [[] for _ in range(D)]
-            for k, i in sorted(seen.items(), key=lambda kv: kv[1]):
-                per_dev[int(k[W - 1]) % D].append(i)
+            for r in range(len(rk)):
+                per_dev[int(rk[r, W - 1]) % D].append(r)
             # grow the level shard until the most-loaded device's seeds
             # fit with the receive-window margin (punctuated-search
             # seed sets can be thousands of states, hash-skewed across
@@ -443,32 +427,36 @@ class ShardedEngine(Engine):
                 self.VB *= 4
 
             res = CheckResult(distinct_states=0,
-                              generated_states=len(seen), depth=0)
+                              generated_states=len(rk), depth=0)
+            # replicated computation: every controller checks the same
+            # interiors and takes identical violation counts
+            self._check_pin_interiors(pin_interiors, res)
             self._states = []
             self._parents = []
             self._lanes = []
 
             # root invariants/constraints (levels get theirs in the
             # step)
-            inv_r, con_r = (np.asarray(a) for a in self._phase2(rootsb))
+            inv_r, con_r = (np.asarray(a) for a in self._phase2(
+                {k: jnp.asarray(v) for k, v in roots.items()}))
 
             carry_np = self._fresh_sharded_carry_host()
             nl = np.zeros((D,), np.int32)
             for d in range(D):
                 for r, i in enumerate(per_dev[d]):
-                    for k in init_arrs:
-                        carry_np["lvl"][k][d, r] = init_arrs[k][i]
+                    for k in roots:
+                        carry_np["lvl"][k][d, r] = roots[k][i]
                     carry_np["lpar"][d, r] = -1
                     carry_np["llane"][d, r] = -1
                     carry_np["linv"][d, r] = inv_r[i]
                     carry_np["lcon"][d, r] = con_r[i]
                 nl[d] = len(per_dev[d])
-                rk = root_fp[per_dev[d]]                   # [n, W]
+                rkd = rk[per_dev[d]]                       # [n, W]
                 # host-side probe placement into the empty table shard
-                slots = self._host_probe_assign(rk, vcap=self.VB)
+                slots = self._host_probe_assign(rkd, vcap=self.VB)
                 for r, sl in enumerate(slots):
                     for w in range(W):
-                        carry_np["vis"][w][d, sl] = rk[r, w]
+                        carry_np["vis"][w][d, sl] = rkd[r, w]
                     carry_np["jslot"][d, r] = sl
             carry_np["n_lvl"] = nl
             carry = self._to_device(carry_np)

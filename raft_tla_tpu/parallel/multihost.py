@@ -26,15 +26,20 @@ for DCN) land on oracle-identical counts.
 
 Constraints vs the single-host ShardedEngine:
 - `store_states` must be False: the trace archive would be sharded
-  across hosts, and parent ids cross host boundaries.  Run the
-  single-host engine (or the oracle) to reconstruct a witness trace
-  for a violation found at scale.
-- Level/send capacities must be pre-sized (lcap/fcap/scap): their
-  growth rebuilds global arrays mid-run, which needs a resharding step
-  that is not implemented, so an overflow raises instead of silently
-  growing.  The visited table DOES grow across hosts (the rehash is a
-  shard_map program, and every controller takes the same growth
-  decision from the replicated scalar matrix).
+  across hosts, and parent ids cross host boundaries.  A violation
+  found at scale is still actionable: every controller decodes the
+  violating states on its own shards (``Violation.state``), so the
+  bad state prints without a local re-run — only the parent *trace*
+  needs the single-host engine (or the oracle) to reconstruct.
+- Level/send/compaction capacities (lcap/fcap/scap) GROW mid-run like
+  the single-host engine's: every controller takes the identical
+  growth branch from the replicated scalar matrix and re-homes its
+  shards into identically-shaped new global arrays in lockstep
+  (mesh.py `_grow_sharded` runs as SPMD ops on the P("d") arrays).
+  The visited table grows the same way (`_rehash_sharded`).  Proven
+  under 2 controllers by
+  tests/test_multihost.py::test_multihost_midrun_growth — pre-sizing
+  is a performance choice (growth replays the level), not a limit.
 """
 
 from __future__ import annotations

@@ -1,0 +1,118 @@
+"""Incremental per-action fingerprints (engine/fingerprint.py
+"Incremental" section): bit-identity against the direct
+min-over-permutations hash on real reachable states, across the
+action families — including membership (AddNewServer / DeleteServer /
+Catchup / CheckOldConfig, config entries inside logs and messages) and
+the unreliable-network lanes (Duplicate / Drop).
+
+The claim rests on u32 modular-sum associativity plus exact
+cancellation of untouched superset terms; these tests falsify any
+touch-superset omission or relabel mismatch, because a single wrong
+position yields a different 64/128-bit key with probability ~1."""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import (Bounds, ModelConfig, NEXT_ASYNC,
+                                 NEXT_DYNAMIC, NEXT_FULL)
+from raft_tla_tpu.engine.bfs import Engine
+from raft_tla_tpu.models.explore import explore
+from raft_tla_tpu.ops.codec import encode, widen
+from raft_tla_tpu.utils import cat_arrays as _cat
+
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+# membership: Server=4 > InitServer=3, NextDynamic — covers catchup
+# splices, CheckOldConfig self-sends, ConfigEntry payload relabeling
+MEMB = ModelConfig(
+    n_servers=4, init_servers=(0, 1, 2), values=(1,),
+    next_family=NEXT_DYNAMIC, symmetry=True, max_inflight_override=6,
+    bounds=Bounds.make(max_log_length=2, max_timeouts=1,
+                       max_client_requests=1, max_membership_changes=1))
+
+# unreliable network: Duplicate / Drop lanes
+UNREL = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_FULL, symmetry=False, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_restarts=1, max_client_requests=1))
+
+
+def _frontier_batch(cfg, n_rows, depth):
+    """Reachable states from a shallow oracle BFS, encoded batch-major
+    int32 — enough action variety to light up every family."""
+    r = explore(cfg, max_depth=depth, keep_states=True)
+    lay = Engine(cfg, chunk=16, store_states=False).lay
+    rows = [encode(lay, sv, h) for sv, h in list(r.states.values())]
+    rows = rows[:: max(1, len(rows) // n_rows)][:n_rows]
+    return widen(_cat([{k: np.asarray(v)[None] for k, v in s.items()}
+                       for s in rows]))
+
+
+def _assert_identity(cfg, depth=4, chunk=16):
+    eng = Engine(cfg, chunk=chunk, store_states=False)
+    assert eng.fpr.supports_incremental()
+    batch = _frontier_batch(cfg, chunk, depth)
+    n = len(batch["ct"])
+    svT = {k: np.moveaxis(np.concatenate(
+        [v, np.zeros((chunk - n,) + v.shape[1:], v.dtype)]), 0, -1)
+        for k, v in batch.items()}
+    valid = np.arange(chunk) < n
+
+    import jax
+    import jax.numpy as jnp
+
+    def run(incr):
+        eng.incremental_fp = incr
+        cand, elive, fp, take, famx, n_e = jax.jit(
+            lambda sv, va: eng._expand_fp_chunk(
+                sv, va, eng.FAM_CAPS, eng.FCAP))(
+            {k: jnp.asarray(v) for k, v in svT.items()},
+            jnp.asarray(valid))
+        return (np.asarray(elive), np.asarray(fp))
+
+    elive_i, fp_i = run(True)
+    elive_d, fp_d = run(False)
+    np.testing.assert_array_equal(elive_i, elive_d)
+    assert elive_i.any(), "no enabled candidates — test config too small"
+    np.testing.assert_array_equal(fp_i[:, elive_i], fp_d[:, elive_d])
+
+
+def test_identity_micro():
+    _assert_identity(MICRO)
+
+
+def test_identity_membership_dynamic():
+    """The widest family set: membership actions, catchup, CoC, cfg
+    entries in logs AND messages, under the InitServer-fixing
+    symmetry subgroup."""
+    _assert_identity(MEMB, depth=5, chunk=32)
+
+
+def test_identity_unreliable_fp128():
+    """Duplicate/Drop lanes + 4-stream fingerprints."""
+    _assert_identity(UNREL.with_(fp128=True), depth=4)
+
+
+def test_counts_match_direct_engine():
+    """End-to-end: the incremental engine lands on the oracle's exact
+    counts (the direct engine's parity is pinned by the existing
+    differential suite)."""
+    want = explore(MEMB, max_depth=6)
+    eng = Engine(MEMB, chunk=64, store_states=False)
+    assert eng.incremental_fp
+    r = eng.check(max_depth=6)
+    assert r.distinct_states == want.distinct_states
+    assert r.generated_states == want.generated_states
+    assert r.depth == want.depth
+
+
+def test_big_symmetry_group_falls_back():
+    cfg = MICRO.with_(n_servers=5, init_servers=(0, 1, 2, 3, 4))
+    eng = Engine(cfg, chunk=16, store_states=False)
+    assert not eng.fpr.supports_incremental()    # P = 120 > 24

@@ -53,5 +53,10 @@ print("RESULT " + json.dumps(dict(
     distinct=int(r.distinct_states), depth=int(r.depth),
     generated=int(r.generated_states),
     violations=int(r.violations_global),
+    # shard-local decoded violating states: a mesh-scale hit is
+    # actionable without a single-host re-run (only the parent trace
+    # needs one — multihost module docstring)
+    viol_local=[[v.invariant, str(v.state)]
+                for v in r.violations[:3]],
     final_caps=[int(eng.LB), int(eng.SC), int(eng.FC)])),
     flush=True)
