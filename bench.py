@@ -65,8 +65,13 @@ def perf_floor(rate, max_depth, plat, floor_path, gate_ok=True,
         data["tlc_membership_S3_T3_L3"]["best_states_per_sec"] = \
             round(rate, 1)
         data["tlc_membership_S3_T3_L3"]["source"] = "bench.py auto-bump"
-        with open(floor_path, "w") as fh:
+        # write-then-rename: a floor file truncated by a mid-dump kill
+        # would silently DISABLE the regression gate on every later run
+        # (the loader treats unreadable as no-floor)
+        tmp = floor_path + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(data, fh, indent=2)
+        os.replace(tmp, floor_path)
     return info, status == "hard"
 
 

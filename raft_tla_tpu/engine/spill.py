@@ -351,7 +351,6 @@ class SpillEngine(Engine):
 
         n_states = 0       # running global id offset
         n_vis = n_roots
-        gen_committed = 0  # device n_gen is monotone; track the delta
         depth = 0
         frontier_blocks: List = []
 
@@ -430,8 +429,19 @@ class SpillEngine(Engine):
             t1 = time.time()
             self._lvl_parts.append([])
             level_new = 0
-            gen_before = gen_committed
+            level_gen = 0
             next_blocks: List = []
+
+            def drain_gen():
+                # drain the device generated-counter into the host's
+                # Python ints each segment: it is an int32, and a whole
+                # beyond-the-wall run generates ~4e9 successors — kept
+                # monotone on device it would wrap negative
+                nonlocal level_gen, carry
+                g = int(np.asarray(carry["n_gen"]))
+                res.generated_states += g
+                level_gen += g
+                carry = dict(carry, n_gen=jnp.int32(0))
 
             for seg_rows, seg_gids in self._resegment(
                     frontier_blocks, self.SEGF):
@@ -461,6 +471,12 @@ class SpillEngine(Engine):
                             out = harvest_block(blk)
                             if out is not None:
                                 next_blocks.append(out)
+                        # re-check the load bound now that n_vis moved:
+                        # a dense segment can spill several SEGL's worth
+                        # of fresh keys before the next segment-boundary
+                        # check, and a proactive grow here is far
+                        # cheaper than the reactive hovf trip+replay
+                        carry = self._grow_table_if_needed(carry, n_vis)
                     elif int(s[S_NLVL]) >= spill_floor:
                         carry, blk = self._spill_segment(
                             carry, int(s[S_NLVL]))
@@ -470,7 +486,8 @@ class SpillEngine(Engine):
                             out = harvest_block(blk)
                             if out is not None:
                                 next_blocks.append(out)
-                gen_committed = int(np.asarray(carry["n_gen"]))
+                        carry = self._grow_table_if_needed(carry, n_vis)
+                drain_gen()
                 # final spill for this segment epoch happens lazily —
                 # rows stay on device and keep accumulating across
                 # frontier segments until the floor trips or the level
@@ -485,10 +502,9 @@ class SpillEngine(Engine):
                 out = harvest_block(blk)
                 if out is not None:
                     next_blocks.append(out)
-            gen_committed = int(np.asarray(carry["n_gen"]))
+            drain_gen()
             flush_archives()
-            res.generated_states += gen_committed - gen_before
-            if level_new == 0 and gen_committed == gen_before:
+            if level_new == 0 and level_gen == 0:
                 # pruned-only frontier cannot occur here (host drops
                 # pruned rows), but an empty-frontier guard keeps the
                 # depth semantics aligned with engine/bfs
@@ -512,8 +528,12 @@ class SpillEngine(Engine):
     # ------------------------------------------------------------------
 
     def _grow_table_if_needed(self, carry, n_vis: int):
-        """Between-segment load check: a segment epoch can add at most
-        SEGL - FCAP keys before its mandatory spill sync."""
+        """Proactive load check, run at segment boundaries AND after
+        every mid-segment spill/trip (n_vis moves there too): the table
+        can take at most SEGL - FCAP more keys before the next check.
+        A rehash here is safe mid-segment — the cursor and frontier
+        segment ride in the carry untouched — and far cheaper than the
+        reactive hovf trip+replay it preempts."""
         need = n_vis + self.SEGL - self.FCAP
         if need > self._LOAD_MAX * self.VCAP:
             while need > self._LOAD_MAX * self.VCAP:

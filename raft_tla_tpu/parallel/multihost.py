@@ -25,12 +25,17 @@ x two virtual CPU devices each (gloo collectives — the CPU stand-in
 for DCN) land on oracle-identical counts.
 
 Constraints vs the single-host ShardedEngine:
-- `store_states` must be False: the trace archive would be sharded
-  across hosts, and parent ids cross host boundaries.  A violation
-  found at scale is still actionable: every controller decodes the
-  violating states on its own shards (``Violation.state``), so the
-  bad state prints without a local re-run — only the parent *trace*
-  needs the single-host engine (or the oracle) to reconstruct.
+- `store_states=True` needs `trace_dir=` — a directory every
+  controller can reach (TLC's distributed workers write worker-local
+  ``states/`` files to shared storage the same way).  Each controller
+  archives its own device shards per level; ``trace()`` on any
+  controller merges the per-controller files device-major (the global
+  id order) and replays the full witness chain, so a violation found
+  at mesh scale has a trace without a single-host re-run
+  (tests/test_multihost.py::test_multihost_violation_trace).  Without
+  a trace_dir, violations still print decoded states shard-locally
+  (``Violation.state``).  store_states cannot be combined with
+  checkpointing (archives are not part of the checkpoint shards).
 - Level/send/compaction capacities (lcap/fcap/scap) GROW mid-run like
   the single-host engine's: every controller takes the identical
   growth branch from the replicated scalar matrix and re-homes its

@@ -3,6 +3,9 @@ raft_tla_tpu.parallel.multihost (lazily, AFTER init_distributed)."""
 
 from __future__ import annotations
 
+import os
+import time
+
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -23,18 +26,156 @@ class MultiHostEngine(ShardedEngine):
     state files).  Mid-run capacity growth works too: the growth
     decision comes from the replicated scal matrix, so every controller
     re-homes its shards into identically-shaped new global arrays in
-    lockstep."""
+    lockstep.
+
+    Trace archives (``store_states=True``) follow the same worker-local
+    pattern: pass ``trace_dir=`` (a directory every controller can
+    reach — TLC's distributed workers likewise write worker-local
+    ``states/`` files to shared storage) and each controller writes its
+    device shards of the per-level parent/lane/state arrays to
+    ``trace_arch.proc<k>.npz`` when ``check()`` finishes.  ``trace()``
+    on ANY controller then merges the files device-major (global ids
+    are assigned device-major per level, so the merge reproduces the
+    single-host archive exactly) and replays the parent chain — a
+    violation found at mesh scale has a witness trace without a
+    single-host re-run."""
 
     def __init__(self, cfg: ModelConfig, chunk: int = 512,
-                 store_states: bool = False, **kw):
-        if store_states:
+                 store_states: bool = False, trace_dir: str = None, **kw):
+        if store_states and trace_dir is None:
             raise ValueError(
-                "MultiHostEngine requires store_states=False (the "
-                "trace archive cannot span hosts); reproduce traces "
-                "with the single-host engine")
+                "store_states under MultiHostEngine needs trace_dir= — "
+                "a directory shared by every controller — so the "
+                "per-controller archive shards can be merged at trace "
+                "time")
+        self.trace_dir = trace_dir
+        self._arch_merged = False
         kw.pop("devices", None)
         super().__init__(cfg, devices=jax.devices(), chunk=chunk,
-                         store_states=False, **kw)
+                         store_states=store_states, **kw)
+
+    # -- per-controller trace archives ---------------------------------
+
+    def check(self, *args, **kw):
+        # bind against the real signature so positionally-passed
+        # checkpoint_path/resume_from cannot bypass the guard
+        import inspect
+        bound = inspect.signature(ShardedEngine.check).bind(
+            self, *args, **kw)
+        if self.store_states and (
+                bound.arguments.get("checkpoint_path") or
+                bound.arguments.get("resume_from")):
+            raise ValueError(
+                "store_states + checkpointing is unsupported under "
+                "MultiHostEngine (trace archives are not part of the "
+                "checkpoint shards)")
+        res = super().check(*args, **kw)
+        if self.store_states:
+            self._write_trace_archive(res)
+        return res
+
+    def _arch_path(self, k: int) -> str:
+        return os.path.join(self.trace_dir, f"trace_arch.proc{k}.npz")
+
+    def _run_stamp(self, res):
+        """Identifies THIS run's archives: every controller computes the
+        same stamp (the counts are replicated across controllers), while
+        a stale file left in a reused trace_dir by a DIFFERENT run
+        mismatches and keeps the merge polling instead of silently
+        mixing shards.  (A rerun of the identical model on the identical
+        mesh stamps identically — and, the engine being deterministic,
+        writes identical archives, so the merge stays correct.)"""
+        return (f"{self.cfg!r}|D={self.D}|np={jax.process_count()}"
+                f"|depth={res.depth}|distinct={res.distinct_states}"
+                f"|generated={res.generated_states}")
+
+    def _write_trace_archive(self, res):
+        os.makedirs(self.trace_dir, exist_ok=True)
+        payload = {"n_proc": np.int64(jax.process_count()),
+                   "n_levels": np.int64(len(self._parents)),
+                   "stamp": np.array(self._run_stamp(res))}
+        for L in range(len(self._parents)):
+            payload[f"par{L}"] = self._parents[L]
+            payload[f"lane{L}"] = self._lanes[L]
+            payload[f"segs{L}"] = np.asarray(
+                self._arch_segs[L], np.int64).reshape(-1, 2)
+            for k, v in self._states[L].items():
+                payload[f"st{L}_{k}"] = v
+        # write-then-rename so a reader polling for the file never
+        # opens a half-written archive
+        tmp = self._arch_path(jax.process_index()) + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, self._arch_path(jax.process_index()))
+        self._arch_merged = False
+
+    def _ensure_merged(self, timeout_s: float = 120.0):
+        """Merge every controller's archive file into full per-level
+        arrays (device-major = global id order), in place of the local
+        shard archives.  Polls briefly for files other controllers may
+        still be writing."""
+        if self._arch_merged:
+            return
+        n_proc = jax.process_count()
+        deadline = time.time() + timeout_s
+        # this controller's own file carries the current run's stamp;
+        # other controllers' files must match it (a reused trace_dir
+        # can hold a previous run's archives until every controller of
+        # THIS run finishes writing — poll, don't mix)
+        own = np.load(self._arch_path(jax.process_index()))
+        want_stamp = str(own["stamp"])
+        own.close()
+        files = []
+        for k in range(n_proc):
+            while True:
+                if os.path.exists(self._arch_path(k)):
+                    f = np.load(self._arch_path(k))
+                    if "stamp" in f and str(f["stamp"]) == want_stamp:
+                        files.append(f)
+                        break
+                    f.close()
+                if time.time() > deadline:
+                    raise FileNotFoundError(
+                        f"{self._arch_path(k)}: no archive with this "
+                        f"run's stamp within {timeout_s}s — did "
+                        f"controller {k}'s check() finish, or is "
+                        "trace_dir shared with a different run?")
+                time.sleep(0.2)
+        n_levels = int(files[0]["n_levels"])
+        parents, lanes, states = [], [], []
+        for L in range(n_levels):
+            blocks = {}                       # device -> (file, off, n)
+            for f in files:
+                off = 0
+                for d, n in f[f"segs{L}"]:
+                    blocks[int(d)] = (f, off, int(n))
+                    off += int(n)
+            assert sorted(blocks) == list(range(self.D)), \
+                (sorted(blocks), self.D)
+            keys = [k[len(f"st{L}_"):] for k in files[0].files
+                    if k.startswith(f"st{L}_")]
+
+            def merged(name):
+                return np.concatenate(
+                    [blocks[d][0][name][blocks[d][1]:
+                                        blocks[d][1] + blocks[d][2]]
+                     for d in range(self.D)])
+
+            parents.append(merged(f"par{L}"))
+            lanes.append(merged(f"lane{L}"))
+            states.append({k: merged(f"st{L}_{k}") for k in keys})
+        for f in files:
+            f.close()
+        self._parents, self._lanes, self._states = parents, lanes, states
+        self._arch_merged = True
+
+    def trace(self, gid: int):
+        self._ensure_merged()
+        return super().trace(gid)
+
+    def get_state_arrays(self, gid: int):
+        self._ensure_merged()
+        return super().get_state_arrays(gid)
 
     # -- global-array plumbing -----------------------------------------
 
@@ -138,6 +279,7 @@ class MultiHostEngine(ShardedEngine):
 
         carry = ckpt_carry(self._proc_path(path), z, template, to_global)
         self._parents, self._lanes, self._states = [], [], []
+        self._arch_segs = []
         res = ckpt_result(z, meta)
         z.close()
         return carry, res, meta

@@ -86,6 +86,35 @@ def test_multihost_checkpoint_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_violation_trace(tmp_path):
+    """Mesh-scale witness reconstruction (VERDICT r3 missing #2): a
+    scenario hit under 2 controllers replays its full parent trace
+    across the merged per-controller archive files (trace_dir), so the
+    witness exists WITHOUT a single-host re-run.  The chain must match
+    the oracle's semantics: Init root, an election, a client request
+    and the commit that fires FirstCommit."""
+    want = explore(MICRO.with_(invariants=("FirstCommit",)),
+                   stop_on_violation=True, trace_violations=True)
+    want_labels = want.violations[0].trace
+    outs = _run_pair({"invariants": ["FirstCommit"],
+                      "trace_dir": str(tmp_path / "arch"),
+                      "stop_on_violation": True})
+    assert any(r["violations"] > 0 for r in outs)
+    traced = [t for r in outs for t in r["traces"]]
+    assert traced, f"no controller produced a trace: {outs}"
+    for labels in traced:
+        assert labels[0] == "Init"
+        assert any(lbl.startswith("BecomeLeader") for lbl in labels)
+        assert any(lbl.startswith("ClientRequest") for lbl in labels)
+        assert any(lbl.startswith("AdvanceCommitIndex")
+                   for lbl in labels)
+        # same depth class as the oracle's witness (BFS shortest
+        # trace; the engine chain includes the Init root, the oracle
+        # trace does not)
+        assert len(labels) == len(want_labels) + 1, (labels, want_labels)
+
+
+@pytest.mark.slow
 def test_multihost_midrun_growth():
     """Tiny send/level caps force mid-run capacity growth; every
     controller takes the identical growth branch (replicated scal) and

@@ -10,7 +10,14 @@ depth wall is the visited table (12 B/key fp64, 20 B/key fp128)
 instead of the level buffers.
 
 Usage: python tools/deep_run.py CONFIG DEPTH [--fp128] [--chunk N]
-       [--seg N] [--vcap N] [--tag NAME]
+       [--seg N] [--vcap N] [--tag NAME] [--classic] [--lcap N]
+       [--fcap N] [--native] [--budget N]
+
+--classic uses the in-HBM Engine instead of SpillEngine (for
+depth-exact head-to-heads at depths that still fit); --native also
+runs the native C++ checker at the same depth/budget and records the
+speedup; --budget caps distinct states (level-granular, both engines)
+for budget-exact rather than depth-exact comparisons.
 
 Writes/merges baseline_runs/round4_deep.json:
   {"config2_depth21": {...}, "config2_depth21_fp128": {...}, ...}
@@ -34,19 +41,33 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def main():
+    from raft_tla_tpu.engine.bfs import Engine
     from raft_tla_tpu.engine.spill import SpillEngine
     from tools.measure_baseline import build_cfg
 
     args = sys.argv[1:]
     conf_no = int(args.pop(0))
     depth = int(args.pop(0))
-    fp128 = "--fp128" in args
-    if fp128:
-        args.remove("--fp128")
+    flags = {f: f in args for f in ("--fp128", "--classic", "--native")}
+    for f, on in flags.items():
+        if on:
+            args.remove(f)
+    fp128 = flags["--fp128"]
     opts = dict(zip(args[::2], args[1::2]))
+    known = {"--chunk", "--seg", "--vcap", "--budget", "--tag", "--lcap",
+             "--fcap"}
+    bad = set(opts) - known
+    if bad or len(args) % 2:
+        # fail loud: these depths cannot be cross-checked by any other
+        # checker here, so a silently-ignored typo'd flag would record
+        # an unverifiable row under the wrong parameters
+        raise SystemExit(f"unknown/incomplete options: "
+                         f"{sorted(bad) or args[-1:]} (known: "
+                         f"{sorted(known)})")
     chunk = int(opts.get("--chunk", 4096))
     seg = int(opts.get("--seg", 1 << 22))
     vcap = int(opts.get("--vcap", 1 << 26))
+    budget = int(opts.get("--budget", 10 ** 9))
     tag = opts.get("--tag",
                    f"config{conf_no}_depth{depth}"
                    + ("_fp128" if fp128 else ""))
@@ -54,20 +75,38 @@ def main():
     cfg = build_cfg(conf_no)
     if fp128:
         cfg = cfg.with_(fp128=True)
-    eng = SpillEngine(cfg, chunk=chunk, store_states=False, seg=seg,
-                      vcap=vcap)
+    nat_rec = None
+    if flags["--native"]:
+        from raft_tla_tpu import native
+        nat_cfg = cfg.with_(invariants=()) if conf_no == 5 else cfg
+        nat = native.check(nat_cfg, threads=os.cpu_count() or 1,
+                           max_depth=depth, max_states=budget)
+        nat_rec = {
+            "distinct": int(nat.distinct_states),
+            "depth": int(nat.depth),
+            "seconds": round(nat.seconds, 2),
+            "states_per_sec": round(nat.states_per_sec, 1)}
+        print(json.dumps({"native": nat_rec}), flush=True)
+    if flags["--classic"]:
+        eng = Engine(cfg, chunk=chunk, store_states=False, vcap=vcap,
+                     lcap=int(opts.get("--lcap", 1 << 21)),
+                     fcap=int(opts["--fcap"]) if "--fcap" in opts
+                     else None)
+    else:
+        eng = SpillEngine(cfg, chunk=chunk, store_states=False, seg=seg,
+                          vcap=vcap)
     t0 = time.time()
     eng.check(max_depth=2)                       # warm the jit caches
     compile_s = time.time() - t0
     t0 = time.time()
-    r = eng.check(max_depth=depth, verbose=True)
+    r = eng.check(max_depth=depth, max_states=budget, verbose=True)
     secs = time.time() - t0
     rec = {
-        "engine": "SpillEngine",
+        "engine": type(eng).__name__,
         "config": conf_no, "max_depth": depth,
         "fp_bits": 128 if fp128 else 64,
         "distinct": int(r.distinct_states), "depth": int(r.depth),
-        "depth_exact": True,
+        "depth_exact": budget >= 10 ** 9,
         "seconds": round(secs, 2),
         "states_per_sec": round(r.distinct_states / max(secs, 1e-9), 1),
         "compile_seconds": round(compile_s, 1),
@@ -78,15 +117,28 @@ def main():
         "expected_fp_collisions": float(
             r.distinct_states ** 2 /
             2.0 ** ((128 if fp128 else 64) + 1)),
-        "note": "no CPU checker on this host can reach this depth "
-                "(native OOMs ~65GB RSS; round3 exhaustion probes)",
     }
+    if nat_rec is not None:
+        rec["native"] = nat_rec
+        rec["counts_match"] = (
+            nat_rec["distinct"] == rec["distinct"]
+            and nat_rec["depth"] == rec["depth"])
+        rec["speedup"] = round(rec["states_per_sec"] /
+                               max(nat_rec["states_per_sec"], 1e-9), 2)
+    else:
+        rec["note"] = ("no CPU checker on this host can reach this "
+                       "depth (native OOMs ~65GB RSS; round3 "
+                       "exhaustion probes)")
     data = {}
     if os.path.exists(OUT):
         data = json.load(open(OUT))
     data[tag] = rec
-    with open(OUT, "w") as f:
+    # write-then-rename: an interrupted dump must not destroy earlier
+    # recorded rows (these depths are unreproducible by other checkers)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(data, f, indent=1)
+    os.replace(tmp, OUT)
     print(json.dumps(rec), flush=True)
 
 

@@ -4,7 +4,12 @@ multi-controller CPU run (gloo collectives = the DCN stand-in).
 Usage: python tools/multihost_worker.py <pid> <nproc> <port> [opts-json]
 opts (all optional): {"checkpoint": path, "resume": path,
                       "max_depth": int, "lcap": int, "vcap": int,
-                      "scap": int, "chunk_mult": int}
+                      "scap": int, "chunk_mult": int,
+                      "invariants": [names], "trace_dir": path,
+                      "stop_on_violation": bool}
+trace_dir enables store_states: each controller writes its archive
+shard and the violation-finding controller replays the full witness
+trace across the merged per-controller files (multihost_engine).
 Caller must set XLA_FLAGS=--xla_force_host_platform_device_count=N and
 JAX_PLATFORMS=cpu in the environment BEFORE the interpreter starts.
 Tiny lcap/scap force mid-run capacity growth — exercised by the growth
@@ -37,17 +42,29 @@ from raft_tla_tpu.config import NEXT_ASYNC, Bounds, ModelConfig  # noqa: E402
 cfg = ModelConfig(
     n_servers=2, init_servers=(0, 1), values=(1,),
     next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    invariants=tuple(opts.get("invariants", ())),
     bounds=Bounds.make(max_log_length=1, max_timeouts=1,
                        max_client_requests=1))
 
 D = len(jax.devices())
+trace_dir = opts.get("trace_dir")
 eng = MultiHostEngine(cfg, chunk=opts.get("chunk_mult", 4) * D,
                       lcap=opts.get("lcap", 1 << 12),
                       vcap=opts.get("vcap", 1 << 15),
-                      scap=opts.get("scap"))
+                      scap=opts.get("scap"),
+                      store_states=trace_dir is not None,
+                      trace_dir=trace_dir)
 r = eng.check(max_depth=opts.get("max_depth", 10 ** 9),
               checkpoint_path=opts.get("checkpoint"),
-              resume_from=opts.get("resume"))
+              resume_from=opts.get("resume"),
+              stop_on_violation=opts.get("stop_on_violation", False))
+traces = []
+if trace_dir and r.violations:
+    # mesh-scale witness reconstruction: the controller that holds the
+    # violating shard replays the parent chain across every
+    # controller's archive file (no single-host re-run)
+    for v in r.violations[:2]:
+        traces.append([lbl for lbl, _ in eng.trace(v.state_id)])
 print("RESULT " + json.dumps(dict(
     pid=pid, n_devices=D,
     distinct=int(r.distinct_states), depth=int(r.depth),
@@ -58,5 +75,6 @@ print("RESULT " + json.dumps(dict(
     # needs one — multihost module docstring)
     viol_local=[[v.invariant, str(v.state)]
                 for v in r.violations[:3]],
+    traces=traces,
     final_caps=[int(eng.LB), int(eng.SC), int(eng.FC)])),
     flush=True)
