@@ -93,7 +93,11 @@ def enable_persistent_compilation_cache():
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        # persist even sub-second programs: the warm-start floor on the
+        # tunneled runtime is per-executable round trips, and the many
+        # small root-path programs otherwise recompile every process
+        # (tools/compile_probe.py measured the breakdown)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     except Exception:
         pass                  # older jax without the knob: run uncached
 
