@@ -160,10 +160,6 @@ def cmd_check(args):
         print("--resume and --seed-trace are mutually exclusive",
               file=sys.stderr)
         return 2
-    if getattr(args, "spill", False) and (args.resume or args.checkpoint):
-        print("--spill does not checkpoint yet (engine/spill docstring)",
-              file=sys.stderr)
-        return 2
     oracle_seeds = engine_seeds = None
     if args.seed_trace:
         oracle_seeds, raw = _load_seeds(args.seed_trace)

@@ -202,9 +202,15 @@ def ckpt_write(path, carry, store_states, parents, lanes, states, res,
     os.replace(tmp, path)
 
 
-def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded):
-    """np.load + the meta validation both engines share.  Returns
-    (npz, meta) or raises CheckpointError."""
+def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded, spill=False,
+              expected_format=None):
+    """np.load + the meta validation every engine shares.  Returns
+    (npz, meta) or raises CheckpointError.
+
+    expected_format — optional (meta_key, want_value, why) triple: the
+    engine's checkpoint-format gate, checked here so every engine
+    versions its files one way (meta lacking the key reads as format 1
+    — the pre-versioning era)."""
     import json
     try:
         z = np.load(path, allow_pickle=False)
@@ -215,12 +221,27 @@ def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded):
         raise CheckpointError(f"{path}: not an engine checkpoint "
                               "(no meta record)")
     meta = json.loads(str(z["meta"]))
+    # spill before sharded: a spill checkpoint handed to ShardedEngine
+    # must name SpillEngine, not "the single-device Engine"
+    if bool(meta.get("spill")) != spill:
+        raise CheckpointError(
+            f"{path}: host-spill checkpoint — resume it with "
+            "SpillEngine" if meta.get("spill")
+            else f"{path}: not a SpillEngine checkpoint — resume it "
+            "with the engine that wrote it")
     if bool(meta.get("sharded")) != sharded:
         raise CheckpointError(
             f"{path}: sharded-engine checkpoint — resume it with "
             "ShardedEngine on the same mesh size" if meta.get("sharded")
             else f"{path}: single-device checkpoint — resume it with "
             "the single-device Engine")
+    if expected_format is not None:
+        fkey, want, why = expected_format
+        got = meta.get(fkey, 1)
+        if got != want:
+            raise CheckpointError(
+                f"{path}: checkpoint format {got!r} != {want} ({why}) "
+                "— re-run without --resume")
     for key in _CKPT_BASE_KEYS + tuple(extra_keys):
         if key not in meta:
             raise CheckpointError(
@@ -1207,13 +1228,10 @@ class Engine:
 
     def _load_checkpoint(self, path):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
-                            ("LCAP", "VCAP", "FCAP", "fam_caps",
-                             "layout"), sharded=False)
-        if meta["layout"] != 2:
-            raise CheckpointError(
-                f"{path}: checkpoint storage layout {meta['layout']!r} "
-                "!= 2 (this engine's batch-last/narrow-dtype layout) — "
-                "re-run without --resume")
+                            ("LCAP", "VCAP", "FCAP", "fam_caps"),
+                            sharded=False, expected_format=(
+                                "layout", 2, "this engine's batch-last/"
+                                "narrow-dtype storage layout"))
         self.LCAP, self.VCAP, self.FCAP = (meta["LCAP"], meta["VCAP"],
                                            meta["FCAP"])
         self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])

@@ -64,7 +64,9 @@ from jax import lax
 from ..config import ModelConfig
 from ..models.raft import init_state
 from ..ops.codec import C_OVERFLOW, decode, encode, narrow, widen
-from .bfs import CheckResult, Engine, U32MAX, Violation
+from .bfs import (CheckResult, CheckpointError, Engine, U32MAX,
+                  Violation, ckpt_archives, ckpt_read, ckpt_result,
+                  ckpt_write)
 
 # summary vector layout (int32): the per-window device->host sync
 S_NLVL, S_NGEN, S_OVF, S_FOVF, S_HOVF, S_TRIP, S_LEN = range(7)
@@ -315,44 +317,44 @@ class SpillEngine(Engine):
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
               verbose: bool = False) -> CheckResult:
-        if checkpoint_path is not None or resume_from is not None:
-            raise NotImplementedError(
-                "SpillEngine does not checkpoint yet — its wavefront "
-                "lives in host blocks; use the classic Engine for "
-                "checkpointed runs within its depth range")
         t0 = time.time()
         lay = self.lay
         self._states: List[Dict[str, np.ndarray]] = []
         self._parents: List[np.ndarray] = []
         self._lanes: List[np.ndarray] = []
 
-        # ---- roots (shared admit path: engine/bfs._dedup_roots) ------
-        roots, rk, pin_interiors = self._dedup_roots(seed_states)
-        n_roots = len(rk)
+        if resume_from is not None:
+            (carry, res, frontier_blocks, n_states, n_vis,
+             depth) = self._load_spill_checkpoint(resume_from)
+            root_blk = None
+        else:
+            # ---- roots (shared admit path: engine/bfs._dedup_roots) --
+            roots, rk, pin_interiors = self._dedup_roots(seed_states)
+            n_roots = len(rk)
 
-        res = CheckResult(distinct_states=0, generated_states=n_roots,
-                          depth=0)
-        self._check_pin_interiors(pin_interiors, res)
+            res = CheckResult(distinct_states=0,
+                              generated_states=n_roots, depth=0)
+            self._check_pin_interiors(pin_interiors, res)
 
-        carry = self._fresh_spill_carry()
-        slots = self._host_probe_assign(rk, vcap=self.VCAP)
-        sl = jnp.asarray(slots)
-        carry["vis"] = tuple(
-            carry["vis"][w].at[sl].set(jnp.asarray(rk[:, w]))
-            for w in range(self.W))
-        inv_r, con_r = (np.asarray(a) for a in self._phase2(
-            {k: jnp.asarray(v) for k, v in roots.items()}))
-        roots_T = {k: np.moveaxis(v, 0, -1)
-                   for k, v in narrow(lay, roots).items()}
-        root_blk = dict(rows=roots_T,
-                        lpar=np.full((n_roots,), -1, np.int32),
-                        llane=np.full((n_roots,), -1, np.int32),
-                        linv=inv_r.T, lcon=con_r, n=n_roots)
+            carry = self._fresh_spill_carry()
+            slots = self._host_probe_assign(rk, vcap=self.VCAP)
+            sl = jnp.asarray(slots)
+            carry["vis"] = tuple(
+                carry["vis"][w].at[sl].set(jnp.asarray(rk[:, w]))
+                for w in range(self.W))
+            inv_r, con_r = (np.asarray(a) for a in self._phase2(
+                {k: jnp.asarray(v) for k, v in roots.items()}))
+            roots_T = {k: np.moveaxis(v, 0, -1)
+                       for k, v in narrow(lay, roots).items()}
+            root_blk = dict(rows=roots_T,
+                            lpar=np.full((n_roots,), -1, np.int32),
+                            llane=np.full((n_roots,), -1, np.int32),
+                            linv=inv_r.T, lcon=con_r, n=n_roots)
 
-        n_states = 0       # running global id offset
-        n_vis = n_roots
-        depth = 0
-        frontier_blocks: List = []
+            n_states = 0       # running global id offset
+            n_vis = n_roots
+            depth = 0
+            frontier_blocks = []
 
         def harvest_block(blk):
             """Counts, violations, archives, next-frontier rows for one
@@ -413,11 +415,12 @@ class SpillEngine(Engine):
                  for k in keys})
 
         self._lvl_parts: List[List] = [[]]
-        out = harvest_block(root_blk)
-        flush_archives()
-        if out is not None:
-            frontier_blocks.append(out)
-        res.generated_states = n_roots
+        if root_blk is not None:
+            out = harvest_block(root_blk)
+            flush_archives()
+            if out is not None:
+                frontier_blocks.append(out)
+            res.generated_states = n_roots
         if stop_on_violation and res.violations:
             res.seconds = time.time() - t0
             return res
@@ -514,6 +517,11 @@ class SpillEngine(Engine):
                     sum(int(g.shape[0]) for _r, g in next_blocks))
             frontier_blocks = next_blocks   # the expanded level's
             # blocks are freed here (rebind) unless archived
+            if checkpoint_path is not None and \
+                    depth % max(1, checkpoint_every) == 0:
+                self._save_spill_checkpoint(
+                    checkpoint_path, carry, res, frontier_blocks,
+                    depth, n_states, n_vis)
             if stop_on_violation and res.violations:
                 break
             if verbose:
@@ -524,6 +532,106 @@ class SpillEngine(Engine):
         res.depth = depth
         res.seconds = time.time() - t0
         return res
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (VERDICT r4 #2): at a level boundary the whole
+    # wavefront is host-reachable — the visited table is the ONLY device
+    # state that matters (level segment empty, frontier segment stale:
+    # both rebuild from the host frontier blocks at resume), and the
+    # frontier blocks + counters + archives are already host numpy.
+    # Reuses the engine-family ckpt_* serializer (engine/bfs), with the
+    # frontier blocks riding inside the carry pytree; ckpt_read's
+    # spill=True flag keeps classic/sharded engines from resuming these
+    # files and vice versa.  TLC checkpoints its disk queue + fingerprint
+    # set the same way (/root/reference/.gitignore:4).
+    #
+    # Each checkpoint is a full (not incremental) snapshot; under
+    # store_states=True the cumulative archives rewrite every time, so
+    # long trace-hunting runs should raise checkpoint_every.  The deep
+    # beyond-the-wall runs this exists for run store_states=False, where
+    # a snapshot is the sparse table + the current frontier only.
+    # ------------------------------------------------------------------
+
+    _SPILL_EXTRA_KEYS = ("SEGL", "SEGF", "VCAP", "FCAP", "fam_caps",
+                         "n_fblk")
+
+    def _save_spill_checkpoint(self, path, carry, res, frontier_blocks,
+                               depth, n_states, n_vis):
+        # the table serializes SPARSE (occupied slot indices + keys):
+        # deep runs pre-allocate VCAP for the final level (2^28 slots =
+        # 4 GB/stream-pair at fp128), and a dense dump would write all
+        # of it every level.  Sparse is O(occupied) — the early-level
+        # checkpoints of an hours-scale run cost MBs, not GBs.  An
+        # all-ones key aliases "empty" and would drop out here — the
+        # same 2^-64/2^-128 accepted-risk class as the probe walk
+        # (engine/bfs table docstring).
+        vis_np = [np.asarray(t) for t in carry["vis"]]
+        empty = vis_np[0] == np.uint32(0xFFFFFFFF)
+        for t in vis_np[1:]:
+            empty &= t == np.uint32(0xFFFFFFFF)
+        occ_idx = np.nonzero(~empty)[0].astype(np.int64)
+        ckpt = dict(
+            vis_idx=occ_idx,
+            vis_keys=np.stack([t[occ_idx] for t in vis_np]),
+            fblk=[dict(g=np.asarray(g),
+                       r={k: np.asarray(v) for k, v in rows.items()})
+                  for rows, g in frontier_blocks])
+        n_front = sum(int(g.shape[0]) for _r, g in frontier_blocks)
+        ckpt_write(path, ckpt, self.store_states, self._parents,
+                   self._lanes, self._states, res, dict(
+                       spill=True, depth=depth, n_states=n_states,
+                       n_vis=n_vis, n_front=n_front,
+                       n_fblk=len(frontier_blocks),
+                       SEGL=self.SEGL, SEGF=self.SEGF, VCAP=self.VCAP,
+                       FCAP=self.FCAP, fam_caps=list(self.FAM_CAPS),
+                       layout=2, chunk=self.chunk, cfg=repr(self.cfg)))
+
+    def _load_spill_checkpoint(self, path):
+        z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
+                            self._SPILL_EXTRA_KEYS,
+                            sharded=False, spill=True, expected_format=(
+                                "layout", 2, "this engine's batch-last/"
+                                "narrow-dtype storage layout"))
+        if meta["SEGF"] != self.SEGF:
+            # frontier re-segmentation is count-preserving (first-seen
+            # is parent-order invariant), but a resumed run should be
+            # bit-identical in every observable — including archive
+            # block boundaries — so hold the segment shape fixed
+            raise CheckpointError(
+                f"checkpoint was written with seg={meta['SEGF']}; "
+                f"resume with the same seg (engine has {self.SEGF})")
+        self.SEGL, self.VCAP, self.FCAP = (meta["SEGL"], meta["VCAP"],
+                                           meta["FCAP"])
+        self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])
+        carry = self._fresh_spill_carry()
+        if "carry|vis_idx" not in z or "carry|vis_keys" not in z:
+            raise CheckpointError(
+                f"{path}: checkpoint lacks the sparse visited-table "
+                "records — written by an incompatible engine version; "
+                "re-run without --resume")
+        occ_idx = jnp.asarray(z["carry|vis_idx"])
+        keys = z["carry|vis_keys"]
+        if keys.shape[0] != self.W:
+            raise CheckpointError(
+                f"{path}: checkpoint has {keys.shape[0]} fingerprint "
+                f"streams; engine expects {self.W} (fp64 vs fp128 "
+                "mismatch)")
+        carry["vis"] = tuple(
+            carry["vis"][w].at[occ_idx].set(jnp.asarray(keys[w]))
+            for w in range(self.W))
+        row_keys = list(carry["lvl"].keys())
+        frontier_blocks = []
+        for i in range(meta["n_fblk"]):
+            gids = z[f"carry|fblk|{i}|g"]
+            rows = {k: z[f"carry|fblk|{i}|r|{k}"] for k in row_keys}
+            frontier_blocks.append((rows, gids))
+        template = {"lvl": carry["lvl"]}       # archive key template
+        self._parents, self._lanes, self._states = ckpt_archives(
+            z, meta, template, self.store_states)
+        res = ckpt_result(z, meta)
+        z.close()             # all arrays extracted; don't leak the fd
+        return (carry, res, frontier_blocks, meta["n_states"],
+                meta["n_vis"], meta["depth"])
 
     # ------------------------------------------------------------------
 

@@ -85,6 +85,13 @@ from ..models.raft import init_state
 from ..ops.codec import C_OVERFLOW, NONVIEW_KEYS, decode, encode, \
     narrow, widen
 
+# sharded checkpoint format gate (shared with MultiHostEngine): format
+# 2 = the round-4 content-canonical carry (adds the lrow table);
+# pre-change checkpoints read as format 1 and fail with this message
+# instead of a missing-leaf error deep in ckpt_carry
+_SHARDED_FMT = ("ckpt_format", 2,
+                "the carry gained the content-canonical lrow table")
+
 
 class ShardedEngine(Engine):
     """Engine whose full BFS runs sharded over a device mesh with
@@ -766,7 +773,8 @@ class ShardedEngine(Engine):
                 "multi-process runs")
         ckpt_write(path, carry, self.store_states, self._parents,
                    self._lanes, self._states, res, dict(
-                       sharded=True, D=self.D, chunk=self.chunk,
+                       sharded=True, ckpt_format=2, D=self.D,
+                       chunk=self.chunk,
                        LB=self.LB, VB=self.VB, FC=self.FC, SC=self.SC,
                        fam_caps=list(self.FAM_CAPS),
                        depth=depth, n_states=n_states,
@@ -782,7 +790,7 @@ class ShardedEngine(Engine):
                 "multi-process runs")
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
                             ("D", "LB", "VB", "FC", "SC", "fam_caps"),
-                            sharded=True)
+                            sharded=True, expected_format=_SHARDED_FMT)
         if meta["D"] != self.D:
             raise CheckpointError(
                 f"checkpoint was written on a {meta['D']}-device mesh; "

@@ -84,8 +84,19 @@ class MultiHostEngine(ShardedEngine):
         mismatches and keeps the merge polling instead of silently
         mixing shards.  (A rerun of the identical model on the identical
         mesh stamps identically — and, the engine being deterministic,
-        writes identical archives, so the merge stays correct.)"""
-        return (f"{self.cfg!r}|D={self.D}|np={jax.process_count()}"
+        writes identical archives, so the merge stays correct.)
+
+        Counts are chunk-independent, but per-level archive ROW ORDER
+        (global-id assignment) is not: it depends on the chunk/window
+        packing (chunk, SC) and the buffer capacities that shape the
+        spill boundaries (LB, FC).  Those parameters are therefore part
+        of the stamp — a same-model run with different packing must not
+        match — along with an archive-format version token so a future
+        layout change can never silently merge old shards."""
+        return (f"arch-v2|{self.cfg!r}|D={self.D}"
+                f"|np={jax.process_count()}"
+                f"|chunk={self.chunk}|SC={self.SC}|LB={self.LB}"
+                f"|FC={self.FC}"
                 f"|depth={res.depth}|distinct={res.distinct_states}"
                 f"|generated={res.generated_states}")
 
@@ -233,7 +244,7 @@ class MultiHostEngine(ShardedEngine):
             jax.tree_util.tree_structure(carry), blocks)
         ckpt_write(self._proc_path(path), carry_local, False, [], [],
                    [], res, dict(
-                       sharded=True, multihost=True,
+                       sharded=True, ckpt_format=2, multihost=True,
                        D=self.D, n_proc=jax.process_count(),
                        proc=jax.process_index(), d_idx=d_idx,
                        chunk=self.chunk, LB=self.LB, VB=self.VB,
@@ -244,10 +255,12 @@ class MultiHostEngine(ShardedEngine):
                        n_front=int(n_front), cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
+        from .mesh import _SHARDED_FMT
         z, meta = ckpt_read(self._proc_path(path), repr(self.cfg),
                             self.chunk,
                             ("D", "n_proc", "proc", "d_idx", "LB", "VB",
-                             "FC", "SC", "fam_caps"), sharded=True)
+                             "FC", "SC", "fam_caps"), sharded=True,
+                            expected_format=_SHARDED_FMT)
         if meta["n_proc"] != jax.process_count() or \
                 meta["D"] != self.D:
             raise CheckpointError(

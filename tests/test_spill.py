@@ -89,6 +89,55 @@ def test_spill_fovf_growth_replay():
     _match(r, want)
 
 
+def test_spill_checkpoint_resume_identical(tmp_path):
+    """Interrupt at a mid-run level, resume, land on counts identical
+    to an uninterrupted run — the insurance the hours-scale
+    beyond-the-wall runs need (VERDICT r4 #2; TLC's states/ dir)."""
+    cfg = MICRO.with_(invariants=("ElectionSafety",))
+    e_full = SpillEngine(cfg, chunk=64, store_states=True,
+                         seg=1 << 10, vcap=1 << 12, sync_every=2)
+    full = e_full.check()
+
+    ckpt = str(tmp_path / "spill.ckpt")
+    e1 = SpillEngine(cfg, chunk=64, store_states=True,
+                     seg=1 << 10, vcap=1 << 12, sync_every=2)
+    part = e1.check(max_depth=10, checkpoint_path=ckpt)
+    assert part.depth == 10
+    assert part.distinct_states < full.distinct_states
+
+    e2 = SpillEngine(cfg, chunk=64, store_states=True,
+                     seg=1 << 10, vcap=1 << 12, sync_every=2)
+    resumed = e2.check(resume_from=ckpt)
+    assert resumed.distinct_states == full.distinct_states
+    assert resumed.depth == full.depth
+    assert resumed.generated_states == full.generated_states
+    assert resumed.level_sizes == full.level_sizes
+    # archives survive the resume: every state reconstructible
+    assert sum(len(p) for p in e2._parents) == full.distinct_states
+    # the parent chain replays across the checkpoint boundary
+    gid = full.distinct_states - 1
+    assert [lbl for lbl, _s in e2.trace(gid)] == \
+        [lbl for lbl, _s in e_full.trace(gid)]
+
+
+def test_spill_checkpoint_cross_engine_rejected(tmp_path):
+    """Spill checkpoints resume only on SpillEngine; classic Engine
+    files are rejected symmetrically (distinct wavefront layouts)."""
+    from raft_tla_tpu.engine.bfs import CheckpointError, Engine
+    ckpt = str(tmp_path / "spill.ckpt")
+    SpillEngine(MICRO, chunk=64, store_states=False, seg=1 << 10,
+                vcap=1 << 12).check(max_depth=6, checkpoint_path=ckpt)
+    with pytest.raises(CheckpointError, match="host-spill"):
+        Engine(MICRO, chunk=64, store_states=False).check(
+            resume_from=ckpt)
+    classic = str(tmp_path / "classic.ckpt")
+    Engine(MICRO, chunk=64, store_states=False).check(
+        max_depth=6, checkpoint_path=classic)
+    with pytest.raises(CheckpointError, match="not a SpillEngine"):
+        SpillEngine(MICRO, chunk=64, store_states=False, seg=1 << 10,
+                    vcap=1 << 12).check(resume_from=classic)
+
+
 @pytest.mark.slow
 def test_spill_table_growth_midrun():
     """vcap small enough that the visited table must rehash-grow

@@ -11,7 +11,8 @@ instead of the level buffers.
 
 Usage: python tools/deep_run.py CONFIG DEPTH [--fp128] [--chunk N]
        [--seg N] [--vcap N] [--tag NAME] [--classic] [--lcap N]
-       [--fcap N] [--native] [--budget N]
+       [--fcap N] [--native] [--budget N] [--ckpt FILE]
+       [--resume FILE] [--ckpt-every N]
 
 --classic uses the in-HBM Engine instead of SpillEngine (for
 depth-exact head-to-heads at depths that still fit); --native also
@@ -32,6 +33,8 @@ import json
 import os
 import sys
 import time
+
+import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -55,7 +58,7 @@ def main():
     fp128 = flags["--fp128"]
     opts = dict(zip(args[::2], args[1::2]))
     known = {"--chunk", "--seg", "--vcap", "--budget", "--tag", "--lcap",
-             "--fcap"}
+             "--fcap", "--ckpt", "--resume", "--ckpt-every"}
     bad = set(opts) - known
     if bad or len(args) % 2:
         # fail loud: these depths cannot be cross-checked by any other
@@ -98,8 +101,24 @@ def main():
     t0 = time.time()
     eng.check(max_depth=2)                       # warm the jit caches
     compile_s = time.time() - t0
+    # checkpointing (VERDICT r4 #2): hours-scale runs on the tunneled
+    # TPU die to dropped connections, not engine faults — a level-
+    # boundary checkpoint + --resume makes the depth-21 fp128
+    # corroboration protocol survivable
+    ckpt = opts.get("--ckpt")
+    resume = opts.get("--resume")
+    resume_start = 0
+    if resume:
+        # the checkpoint's distinct count: post-resume throughput is
+        # (delta states)/secs — cumulative/partial would inflate the
+        # recorded rate ~10x on a late resume
+        meta = json.loads(str(np.load(resume)["meta"]))
+        resume_start = int(meta["distinct"])
     t0 = time.time()
-    r = eng.check(max_depth=depth, max_states=budget, verbose=True)
+    r = eng.check(max_depth=depth, max_states=budget, verbose=True,
+                  checkpoint_path=ckpt,
+                  checkpoint_every=int(opts.get("--ckpt-every", 1)),
+                  resume_from=resume)
     secs = time.time() - t0
     rec = {
         "engine": type(eng).__name__,
@@ -107,13 +126,19 @@ def main():
         "fp_bits": 128 if fp128 else 64,
         "distinct": int(r.distinct_states), "depth": int(r.depth),
         "depth_exact": budget >= 10 ** 9,
+        # on a resumed run the wall/rate fields cover the POST-RESUME
+        # portion only (counts stay cumulative); the row is labeled by
+        # resumed_from_checkpoint below so it cannot pass for a
+        # single-session wall measurement
         "seconds": round(secs, 2),
-        "states_per_sec": round(r.distinct_states / max(secs, 1e-9), 1),
+        "states_per_sec": round(
+            (r.distinct_states - resume_start) / max(secs, 1e-9), 1),
         "compile_seconds": round(compile_s, 1),
         "level_sizes": [int(x) for x in r.level_sizes],
         "violations": len(r.violations),
         "overflow_faults": int(r.overflow_faults),
         "chunk": chunk, "seg": seg, "final_vcap": int(eng.VCAP),
+        "resumed_from_checkpoint": bool(resume),
         "expected_fp_collisions": float(
             r.distinct_states ** 2 /
             2.0 ** ((128 if fp128 else 64) + 1)),

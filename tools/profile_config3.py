@@ -1,0 +1,156 @@
+"""Phase-level profile of config #3 (membership, S=4, NextDynamic) —
+the 0.32x single-chip gap (VERDICT r4 #1).
+
+Captures a realistic mid-depth frontier (monkeypatched finalize hook),
+then times the fused chunk step and its subcomponents separately:
+guard pass, guard+materialize+fingerprint (_expand_fp_chunk), and the
+full step (adds probe-insert dedup + phase2 + level append).  The
+differences attribute the per-chunk wall to phases.
+
+Usage: python tools/profile_config3.py [depth_to_capture] [chunk]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from tools.measure_baseline import build_cfg, ENGINE_KW
+from raft_tla_tpu.engine.bfs import Engine
+
+
+def main():
+    cap_depth = int(sys.argv[1]) if len(sys.argv) > 1 else 13
+    kw = dict(ENGINE_KW[3])
+    if len(sys.argv) > 2:
+        kw["chunk"] = int(sys.argv[2])
+    cfg = build_cfg(3)
+    eng = Engine(cfg, store_states=False, **kw)
+    print(f"lanes={eng.A} chunk={eng.chunk} FCAP={eng.FCAP} "
+          f"fam_caps={dict(zip([f.name for f in eng.expander.families], eng.FAM_CAPS))}",
+          flush=True)
+
+    # ---- capture the carry as it enters the finalize at cap_depth ----
+    snap = {}
+    real_fin = eng._fin_jit
+    lvl = [0]
+
+    def fin_hook(carry):
+        lvl[0] += 1
+        if lvl[0] == cap_depth and "front" not in snap:
+            # snapshot to host BEFORE donation invalidates the buffers
+            snap["carry"] = jax.tree_util.tree_map(np.asarray, carry)
+        return real_fin(carry)
+
+    eng._fin_jit = fin_hook
+    t0 = time.time()
+    r = eng.check(max_depth=cap_depth, max_states=1_500_000)
+    print(f"capture run: {r.distinct_states} states depth {r.depth} "
+          f"in {time.time()-t0:.1f}s ({r.states_per_sec:.0f}/s)", flush=True)
+    eng._fin_jit = real_fin
+    carry_h = snap["carry"]
+    # re-finalize the captured carry on device to get a fresh frontier
+    carry = jax.tree_util.tree_map(jnp.asarray, carry_h)
+    carry, out = eng._fin_jit(carry)
+    scal = [int(x) for x in np.asarray(out["scal"])]
+    n_front = scal[3]
+    print(f"captured frontier: {n_front} rows at depth {cap_depth}", flush=True)
+
+    B, A, FCAP = eng.chunk, eng.A, eng.FCAP
+    from raft_tla_tpu.ops.codec import widen
+
+    def chunk_front(carry, base):
+        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B, axis=v.ndim - 1)
+                    for k, v in carry["front"].items()})
+        fmask = lax.dynamic_slice_in_dim(carry["fmask"], base, B)
+        valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
+                 carry["n_front"]) & fmask
+        return sv, valid
+
+    # ---- component jits ----
+    @jax.jit
+    def guard_only(carry, base):
+        sv, valid = chunk_front(carry, base)
+        derb = eng.expander.derived_batch_T(sv)
+        ok = eng.expander.guards_T(sv, derb)
+        return (ok & valid[:, None]).sum()
+
+    @jax.jit
+    def expand_fp(carry, base):
+        sv, valid = chunk_front(carry, base)
+        cand_c, elive, fp, take, famx, n_e = eng._expand_fp_chunk(
+            sv, valid, eng.FAM_CAPS, FCAP)
+        # consume everything so nothing is DCE'd
+        s = sum(jnp.sum(v.astype(jnp.int32)) for v in cand_c.values())
+        return s + fp.astype(jnp.int32).sum() + n_e + elive.sum()
+
+    @jax.jit
+    def expand_fp_nophase2_probe(carry, base):
+        # expand+fp+probe-insert but no phase2/append: isolates dedup
+        sv, valid = chunk_front(carry, base)
+        cand_c, elive, fp, take, famx, n_e = eng._expand_fp_chunk(
+            sv, valid, eng.FAM_CAPS, FCAP)
+        W = eng.W
+        keys = tuple(jnp.where(elive, fp[w], jnp.uint32(0xFFFFFFFF))
+                     for w in range(W))
+        ranks = jnp.arange(FCAP, dtype=jnp.uint32)
+        table, claims, fresh, pos, hv = eng._probe_insert(
+            carry["vis"], carry["claims"], keys, elive, ranks)
+        return fresh.sum() + table[0].astype(jnp.int32).sum()
+
+    @jax.jit
+    def phase2_only(carry, base):
+        sv, valid = chunk_front(carry, base)
+        cand_c, elive, fp, take, famx, n_e = eng._expand_fp_chunk(
+            sv, valid, eng.FAM_CAPS, FCAP)
+        inv, con = eng._phase2_T(cand_c)
+        return inv.sum() + con.sum()
+
+    n_chunks_avail = n_front // B
+    iters = min(10, max(2, n_chunks_avail))
+
+    def bench(name, fn, needs_fresh_carry=False):
+        # warm/compile
+        t0 = time.time()
+        v = fn(carry, jnp.int32(0))
+        v.block_until_ready()
+        tc = time.time() - t0
+        t0 = time.time()
+        for i in range(iters):
+            v = fn(carry, jnp.int32((i % max(1, n_chunks_avail)) * B))
+        np.asarray(v)
+        dt = (time.time() - t0) / iters
+        print(f"{name:28s} compile {tc:6.1f}s   steady {dt*1000:8.2f} ms/chunk"
+              f"   {B/dt:9.0f} parents/s", flush=True)
+        return dt
+
+    t_g = bench("guard pass", guard_only)
+    t_e = bench("expand+materialize+fp", expand_fp)
+    t_p = bench("  + probe-insert", expand_fp_nophase2_probe)
+    t_2 = bench("  + phase2 (no probe)", phase2_only)
+
+    # full fused step: donated carry — run on a copy stream
+    t0 = time.time()
+    c2 = eng._step_jit(jax.tree_util.tree_map(jnp.asarray, carry_h), eng.FAM_CAPS)
+    _ = int(np.asarray(c2["n_lvl"]))
+    tc = time.time() - t0
+    t0 = time.time()
+    for _ in range(iters):
+        c2 = eng._step_jit(c2, eng.FAM_CAPS)
+    _ = int(np.asarray(c2["n_lvl"]))
+    dt = (time.time() - t0) / iters
+    print(f"{'FULL fused step':28s} compile {tc:6.1f}s   steady {dt*1000:8.2f} ms/chunk"
+          f"   {eng.chunk/dt:9.0f} parents/s", flush=True)
+    print(f"attribution: guard={t_g*1000:.1f}  mat+fp={1000*(t_e-t_g):.1f}  "
+          f"probe={1000*(t_p-t_e):.1f}  phase2={1000*(t_2-t_e):.1f}  "
+          f"append+rest={1000*(dt-t_p-(t_2-t_e)):.1f}  (ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""Finer phase attribution for config #3 (follow-up to
+profile_config3.py).  Caches the captured frontier to disk so component
+experiments iterate without re-running the capture BFS.
+
+Components timed:
+  derived_batch_T alone
+  guard pass: all families / message families only / others only
+  materialize without fp
+  materialize + incremental fp (production path)
+  materialize + direct fingerprint_batch_T
+  phase2 at FCAP width vs chunk*4 width
+  append path (gather FCAP rows + narrow + DUS) vs chunk*4 width
+
+Usage: python tools/profile_config3b.py [depth_to_capture] [chunk]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from tools.measure_baseline import build_cfg, ENGINE_KW
+from raft_tla_tpu.engine.bfs import Engine
+from raft_tla_tpu.ops.codec import widen, narrow
+
+CACHE = "/tmp/cfg3_frontier.npz"
+
+
+def capture(eng, cap_depth):
+    if os.path.exists(CACHE):
+        z = np.load(CACHE)
+        if int(z["chunk"]) == eng.chunk:
+            carry_h = {}
+            front = {}
+            for k in z.files:
+                if k.startswith("front|"):
+                    front[k.split("|", 1)[1]] = z[k]
+            return front, z["fmask"], int(z["n_front"])
+    snap = {}
+    real_fin = eng._fin_jit
+    lvl = [0]
+
+    def fin_hook(carry):
+        lvl[0] += 1
+        if lvl[0] == cap_depth and "c" not in snap:
+            snap["c"] = jax.tree_util.tree_map(np.asarray, carry)
+        return real_fin(carry)
+
+    eng._fin_jit = fin_hook
+    r = eng.check(max_depth=cap_depth, max_states=1_500_000)
+    eng._fin_jit = real_fin
+    carry = jax.tree_util.tree_map(jnp.asarray, snap["c"])
+    carry, out = eng._fin_jit(carry)
+    scal = [int(x) for x in np.asarray(out["scal"])]
+    n_front = scal[3]
+    front = {k: np.asarray(v) for k, v in carry["front"].items()}
+    fmask = np.asarray(carry["fmask"])
+    np.savez(CACHE, chunk=eng.chunk, n_front=n_front, fmask=fmask,
+             **{f"front|{k}": v for k, v in front.items()})
+    print(f"captured frontier: {n_front} rows at depth {cap_depth}",
+          flush=True)
+    return front, fmask, n_front
+
+
+def main():
+    cap_depth = int(sys.argv[1]) if len(sys.argv) > 1 else 13
+    kw = dict(ENGINE_KW[3])
+    if len(sys.argv) > 2:
+        kw["chunk"] = int(sys.argv[2])
+    cfg = build_cfg(3)
+    eng = Engine(cfg, store_states=False, **kw)
+    fams = eng.expander.families
+    print(f"lanes={eng.A} chunk={eng.chunk} FCAP={eng.FCAP} "
+          f"W={eng.W} fam_lanes={[(f.name, f.n_lanes) for f in fams]}",
+          flush=True)
+    front_h, fmask_h, n_front = capture(eng, cap_depth)
+
+    B, A, FCAP = eng.chunk, eng.A, eng.FCAP
+    # one chunk of real frontier rows, device-resident, batch-last
+    sv_h = {k: v[..., :B] for k, v in front_h.items()}
+    sv = widen({k: jnp.asarray(v) for k, v in sv_h.items()})
+    valid = jnp.asarray(fmask_h[:B] & (np.arange(B) < n_front))
+    iters = 10
+
+    def bench(name, fn, *args):
+        t0 = time.time()
+        v = jax.block_until_ready(fn(*args))
+        tc = time.time() - t0
+        t0 = time.time()
+        for _ in range(iters):
+            v = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x, v)
+        dt = (time.time() - t0) / iters
+        print(f"{name:34s} compile {tc:6.1f}s   steady {dt*1000:8.2f} ms",
+              flush=True)
+        return dt
+
+    exp = eng.expander
+    MSG = {"UpdateTerm", "CocDiscard", "Receive", "Duplicate", "Drop"}
+
+    @jax.jit
+    def derived_only(sv):
+        d = exp.derived_batch_T(sv)
+        return sum(jnp.sum(v.astype(jnp.int32)) for v in d.values())
+
+    def guard_subset(which):
+        @jax.jit
+        def g(sv):
+            derb = exp.derived_batch_T(sv)
+
+            def one(svx, derx):
+                oks = []
+                for fam in fams:
+                    if which != "all" and \
+                            ((fam.name in MSG) != (which == "msg")):
+                        continue
+                    lane = jax.vmap(
+                        fam.fn,
+                        in_axes=(None, None) + (0,) * len(fam.params))
+                    ok, _ = lane(svx, derx,
+                                 *[jnp.asarray(p) for p in fam.params])
+                    oks.append(ok.reshape(-1))
+                return jnp.concatenate(oks)
+            ok = jax.vmap(one, in_axes=-1, out_axes=-1)(sv, derb)
+            return ok.sum()
+        return g
+
+    # materialize variants need okf/epos: build from a real guard pass
+    @jax.jit
+    def guard_pack(sv, valid):
+        derb = exp.derived_batch_T(sv)
+        ok = exp.guards_T(sv, derb)
+        okf = (ok & valid[:, None]).reshape(B * A)
+        epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1, FCAP)
+        return derb, okf, epos
+
+    derb, okf, epos = jax.block_until_ready(guard_pack(sv, valid))
+    print(f"enabled lanes in this chunk: {int(np.asarray(okf.sum()))} "
+          f"(of {B*A})", flush=True)
+
+    @jax.jit
+    def mat_only(sv, derb, okf, epos):
+        cand, counts = exp.materialize(sv, derb, okf, epos, FCAP,
+                                       eng.FAM_CAPS)
+        return sum(jnp.sum(v.astype(jnp.int32)) for v in cand.values())
+
+    @jax.jit
+    def mat_incr(sv, derb, okf, epos):
+        tables = eng.fpr.parent_tables(sv)
+        cand, counts, fp = exp.materialize(
+            sv, derb, okf, epos, FCAP, eng.FAM_CAPS,
+            delta_fp=(eng.fpr, tables))
+        return sum(jnp.sum(v.astype(jnp.int32)) for v in cand.values()) \
+            + fp.astype(jnp.int32).sum()
+
+    @jax.jit
+    def mat_direct(sv, derb, okf, epos):
+        cand, counts = exp.materialize(sv, derb, okf, epos, FCAP,
+                                       eng.FAM_CAPS)
+        fp = eng.fpr.fingerprint_batch_T(cand)
+        return sum(jnp.sum(v.astype(jnp.int32)) for v in cand.values()) \
+            + fp.astype(jnp.int32).sum()
+
+    # phase2 / append width experiments on synthetic candidate buffers
+    cand_h = jax.block_until_ready(jax.jit(
+        lambda sv, derb, okf, epos: exp.materialize(
+            sv, derb, okf, epos, FCAP, eng.FAM_CAPS)[0])(
+            sv, derb, okf, epos))
+
+    def phase2_w(width):
+        rows = {k: v[..., :width] for k, v in cand_h.items()}
+        rows = jax.tree_util.tree_map(jnp.asarray, rows)
+
+        @jax.jit
+        def p2(rows):
+            inv, con = eng._phase2_T(rows)
+            return inv.sum() + con.sum()
+        return p2, rows
+
+    LCAP = eng.LCAP
+
+    def append_w(width):
+        rows = {k: jnp.asarray(v[..., :width])
+                for k, v in cand_h.items()}
+        lvl = {k: jnp.zeros(v.shape[:-1] + (LCAP,),
+                            narrow(eng.lay, {k: v[..., :1]})[k].dtype)
+               for k, v in rows.items()}
+
+        @jax.jit
+        def ap(rows, lvl, lidx, start):
+            g = {k: v[..., lidx] for k, v in rows.items()}
+            g = narrow(eng.lay, g)
+            out = {k: lax.dynamic_update_slice_in_dim(
+                lvl[k], g[k], start, lvl[k].ndim - 1) for k in lvl}
+            return sum(jnp.sum(v.astype(jnp.int32)) for v in out.values())
+        lidx = jnp.arange(width, dtype=jnp.int32)
+        return ap, rows, lvl, lidx
+
+    bench("derived_batch_T", derived_only, sv)
+    bench("guard msg families (95 lanes)", guard_subset("msg"), sv)
+    bench("guard other families", guard_subset("oth"), sv)
+    bench("guard all", guard_subset("all"), sv)
+    bench("materialize only", mat_only, sv, derb, okf, epos)
+    bench("materialize + incr fp", mat_incr, sv, derb, okf, epos)
+    bench("materialize + direct fp", mat_direct, sv, derb, okf, epos)
+    p2f, p2rows = phase2_w(FCAP)
+    bench(f"phase2 @ {FCAP}", p2f, p2rows)
+    p2f, p2rows = phase2_w(4 * B)
+    bench(f"phase2 @ {4*B}", p2f, p2rows)
+    apf, rows, lvl, lidx = append_w(FCAP)
+    bench(f"append @ {FCAP}", apf, rows, lvl, lidx, jnp.int32(0))
+    apf, rows, lvl, lidx = append_w(4 * B)
+    bench(f"append @ {4*B}", apf, rows, lvl, lidx, jnp.int32(0))
+
+
+if __name__ == "__main__":
+    main()
