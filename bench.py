@@ -38,21 +38,25 @@ VCAP = 1 << 25            # 7.62M keys at a 23% load factor
 
 
 def perf_floor(rate, max_depth, plat, floor_path, gate_ok=True,
-               allow_bump=True):
-    """Perf regression floor (VERDICT r3 #5; tests/test_bench.py).
+               allow_bump=True, key="tlc_membership_S3_T3_L3",
+               headline_depth=None, bump_source="bench.py auto-bump"):
+    """Perf regression floor (VERDICT r3 #5, extended to per-config
+    rows in r5 — VERDICT r4 #6; tests/test_bench.py).
 
     Returns (floor_info dict or None, zero_score bool).  Only applies
-    to the headline-depth run on the recorded machine class — a
+    to the recorded run shape on the recorded machine class — a
     shallower run pays proportionally more per-level dispatch/compile
     and its rate isn't comparable.  A new best (gate passing, >2% up)
     rewrites the floor file so the floor ratchets with the engine."""
+    if headline_depth is None:
+        headline_depth = MAX_DEPTH
     try:
-        fl = json.load(open(floor_path))["tlc_membership_S3_T3_L3"]
+        fl = json.load(open(floor_path))[key]
     except (OSError, KeyError, ValueError):
         return None, False
     if not str(plat).upper().startswith(fl["platform_prefix"].upper()):
         return {"status": f"skipped (platform {plat!r})"}, False
-    if max_depth != MAX_DEPTH:
+    if max_depth != headline_depth:
         return {"status": "skipped (non-headline depth)"}, False
     best = float(fl["best_states_per_sec"])
     warn, hard = best * fl["warn_frac"], best * fl["hard_frac"]
@@ -62,9 +66,8 @@ def perf_floor(rate, max_depth, plat, floor_path, gate_ok=True,
             "hard_below": round(hard, 1), "status": status}
     if allow_bump and gate_ok and rate > best * 1.02:
         data = json.load(open(floor_path))
-        data["tlc_membership_S3_T3_L3"]["best_states_per_sec"] = \
-            round(rate, 1)
-        data["tlc_membership_S3_T3_L3"]["source"] = "bench.py auto-bump"
+        data[key]["best_states_per_sec"] = round(rate, 1)
+        data[key]["source"] = bump_source
         # write-then-rename: a floor file truncated by a mid-dump kill
         # would silently DISABLE the regression gate on every later run
         # (the loader treats unreadable as no-floor)
@@ -141,8 +144,11 @@ def main():
     nat_rate = nat.states_per_sec
 
     # -- TPU engine, same depth ----------------------------------------
+    # ocap pre-sized: the early nearly-all-fresh levels outgrow the
+    # default chunk*4 fresh-row buffer, and the growth replay would
+    # re-run a level inside the timed window
     eng = Engine(cfg, chunk=chunk, store_states=False, lcap=LCAP,
-                 vcap=VCAP)
+                 vcap=VCAP, ocap=1 << 14)
     t_compile = time.time()
     eng.check(max_depth=2)                      # warm the jit caches
     t_compile = time.time() - t_compile

@@ -324,6 +324,7 @@ class Engine:
                  store_states: bool = True,
                  lcap: int = 1 << 14, vcap: int = 1 << 17,
                  fcap: Optional[int] = None,
+                 ocap: Optional[int] = None,
                  incremental_fp: bool = True):
         enable_persistent_compilation_cache()
         self.cfg = cfg
@@ -352,6 +353,18 @@ class Engine:
         # whose mid-run recompile costs ~100s on the tunneled TPU
         self.FCAP = int(fcap) if fcap else min(
             self.chunk * self.A, max(self.chunk * 16, 1 << 13))
+        # OCAP bounds the POST-DEDUP fresh-row buffer: phase2 +
+        # narrow + level append run at this width, not FCAP.  Fresh
+        # rows per chunk are enabled * (1 - dedup hit rate) — typically
+        # ~1-4x chunk where enabled can exceed 20x chunk on the
+        # membership config, so the second compaction cuts the
+        # append-side work ~8x (measured 17+21 ms -> 8+11 ms per chunk
+        # at FCAP=2^16 vs 2^13, tools/profile_config3b.py).  A chunk
+        # whose fresh count exceeds OCAP trips oovf and the level
+        # replays with OCAP grown (same discipline as FCAP/fam caps).
+        self.OCAP = self._round_cap(min(self.FCAP, int(ocap) if ocap
+                                        else max(4 * self.chunk,
+                                                 1 << 11)))
         self.LCAP = self._round_cap(
             max(lcap, 4 * self.chunk, 4 * self.FCAP))
         # open-addressing table: power-of-two capacity (mask indexing)
@@ -689,6 +702,7 @@ class Engine:
         B, A, W = self.chunk, self.A, self.W
         LCAP = carry["lpar"].shape[0]
         FCAP = carry["cidx"].shape[0]
+        OCAP = carry["oidx"].shape[0]
         VCAP = carry["vis"][0].shape[0]
         N = B * A
         base = carry["base"]        # device-resident chunk cursor: a
@@ -719,37 +733,49 @@ class Engine:
                      for w in range(W))
         # any overflow means this level replays — stop inserting so the
         # journal stays the exact record of this level's table writes
-        gate = ~(carry["ovf"] | fovf | carry["hovf"])
+        gate = ~(carry["ovf"] | fovf | carry["hovf"] | carry["oovf"])
         ranks = jnp.arange(FCAP, dtype=jnp.uint32)
         table, claims, fresh, pos, hv = self._probe_insert(
             carry["vis"], carry["claims"], keys, elive & gate, ranks)
         hovf = carry["hovf"] | hv
         n_fresh = fresh.sum(dtype=jnp.int32)
-        ovf_now = carry["n_lvl"] + n_fresh > LCAP - FCAP
-        # level buffer would overflow: revert THIS chunk's inserts on
-        # the spot (earlier chunks' stay until finalize's abandon
-        # clears them via the journal), then skip the append
-        ridx = jnp.where(fresh & ovf_now, pos, VCAP)
+        # two chunk-local overflows share the revert path: level buffer
+        # full (ovf — margin is OCAP, the most one chunk can append)
+        # and fresh-compaction buffer blown (oovf)
+        ovf_now = carry["n_lvl"] + n_fresh > LCAP - OCAP
+        oovf_now = n_fresh > OCAP
+        bad_now = ovf_now | oovf_now
+        # revert THIS chunk's inserts on the spot (earlier chunks' stay
+        # until finalize's abandon clears them via the journal), then
+        # skip the append
+        ridx = jnp.where(fresh & bad_now, pos, VCAP)
         table = tuple(table[w].at[ridx].set(U32MAX, mode="drop")
                       for w in range(W))
-        fresh = fresh & ~ovf_now
-        n_fresh = jnp.where(ovf_now, 0, n_fresh)
+        fresh = fresh & ~bad_now
+        n_fresh = jnp.where(bad_now, 0, n_fresh)
         ovf = carry["ovf"] | ovf_now
+        oovf = carry["oovf"] | oovf_now
 
+        # second compaction: FCAP candidate slots -> OCAP fresh rows.
+        # Everything downstream (phase2, narrow, the level append) runs
+        # at OCAP width — fresh rows are the dedup survivors, typically
+        # ~8x fewer than enabled candidates on wide-grid configs
+        # (tools/profile_config3b.py measured the width halves the
+        # append+phase2 cost even at 8x).
         slot = jnp.arange(FCAP, dtype=jnp.int32)
         lpos = jnp.where(fresh,
-                         jnp.cumsum(fresh.astype(jnp.int32)) - 1, FCAP)
+                         jnp.cumsum(fresh.astype(jnp.int32)) - 1, OCAP)
         lidx = lax.optimization_barrier(
-            jnp.zeros((FCAP,), jnp.int32).at[lpos].set(
-                slot, mode="drop"))                      # out slot -> slot
+            jnp.zeros((OCAP,), jnp.int32).at[lpos].set(
+                slot, mode="drop"))                # out slot -> FCAP slot
 
-        # contiguous append at n_lvl: gather FCAP rows, one
+        # contiguous append at n_lvl: gather OCAP rows, one
         # dynamic_update_slice per array.  Rows past n_fresh are
         # garbage but live beyond the new n_lvl, so later chunks
         # overwrite them and finalize masks them by n_lvl.  The start
         # clamp only engages when the level has overflowed, in which
         # case ovf forces a replay anyway.
-        start = jnp.minimum(carry["n_lvl"], LCAP - FCAP)
+        start = jnp.minimum(carry["n_lvl"], LCAP - OCAP)
         lane = take[lidx]                                # original lane id
         rows = lax.optimization_barrier(
             {k: cand_c[k][..., lidx] for k in cand_c})   # batch-last
@@ -775,9 +801,11 @@ class Engine:
         return dict(carry, vis=table, claims=claims, lvl=lvl, lpar=lpar,
                     llane=llane, jslot=jslot, linv=linv, lcon=lcon,
                     n_lvl=jnp.minimum(carry["n_lvl"] + n_fresh,
-                                      LCAP - FCAP),
+                                      LCAP - OCAP),
                     n_gen=n_gen, ovf=ovf, fovf=fovf, hovf=hovf,
-                    famx=famx, base=base + B)
+                    oovf=oovf, famx=famx,
+                    ofx=jnp.maximum(carry["ofx"], n_fresh),
+                    base=base + B)
 
     # ------------------------------------------------------------------
     # per-level finalize: scalar aggregation, next-frontier swap,
@@ -809,7 +837,8 @@ class Engine:
         VCAP = carry["vis"][0].shape[0]
         n_lvl = carry["n_lvl"]
         g_off = carry["g_off"]
-        bad = carry["ovf"] | carry["fovf"] | carry["hovf"]
+        bad = carry["ovf"] | carry["fovf"] | carry["hovf"] | \
+            carry["oovf"]
         validrow = jnp.arange(LCAP, dtype=jnp.int32) < n_lvl
         inv_ok = (carry["linv"] | ~validrow[None, :]
                   if self.inv_names else carry["linv"])   # [n_inv, LCAP]
@@ -847,21 +876,25 @@ class Engine:
         scal = jnp.concatenate([jnp.stack([
             n_lvl, n_viol, faults, n_front,
             carry["ovf"].astype(jnp.int32), carry["fovf"].astype(jnp.int32),
-            carry["n_gen"], n_expand, carry["hovf"].astype(jnp.int32)]),
+            carry["n_gen"], n_expand, carry["hovf"].astype(jnp.int32),
+            carry["oovf"].astype(jnp.int32), carry["ofx"]]),
             carry["famx"]])
         new_carry = dict(carry, vis=vis, front=front, lvl=lvl,
                          fmask=fmask, n_front=n_front,
                          n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
                          ovf=jnp.bool_(False), fovf=jnp.bool_(False),
-                         hovf=jnp.bool_(False),
+                         hovf=jnp.bool_(False), oovf=jnp.bool_(False),
                          famx=jnp.zeros_like(carry["famx"]),
+                         ofx=jnp.int32(0),
                          base=jnp.int32(0), pg_off=pg_off, g_off=g_next)
         return new_carry, dict(inv_ok=inv_ok, scal=scal)
 
     # ------------------------------------------------------------------
 
-    def _fresh_carry(self, lcap: int, vcap: int, fcap: Optional[int] = None):
+    def _fresh_carry(self, lcap: int, vcap: int, fcap: Optional[int] = None,
+                     ocap: Optional[int] = None):
         fcap = fcap if fcap is not None else self.FCAP
+        ocap = ocap if ocap is not None else self.OCAP
         one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
         # frontier/level state buffers are BATCH-LAST ([..., lcap]) —
         # see the chunk step's layout note
@@ -879,15 +912,18 @@ class Engine:
             lpar=jnp.full((lcap,), -1, jnp.int32),
             llane=jnp.full((lcap,), -1, jnp.int32),
             cidx=jnp.zeros((fcap,), jnp.int32),   # FCAP shape anchor
+            oidx=jnp.zeros((ocap,), jnp.int32),   # OCAP shape anchor
             n_lvl=jnp.int32(0),
             n_gen=jnp.int32(0),
             famx=jnp.zeros((len(self.expander.families),), jnp.int32),
+            ofx=jnp.int32(0),       # max fresh rows in any chunk
             base=jnp.int32(0),      # chunk cursor within the frontier
             g_off=jnp.int32(0),     # global state-id offset (this level)
             pg_off=jnp.int32(0),    # global state-id offset (frontier)
             ovf=jnp.bool_(False),
             fovf=jnp.bool_(False),
             hovf=jnp.bool_(False),  # probe-round budget blown
+            oovf=jnp.bool_(False),  # fresh-compaction buffer blown
             front={k: jnp.zeros_like(v) for k, v in zeros.items()},
             fmask=jnp.zeros((lcap,), bool),
             n_front=jnp.int32(0),
@@ -901,7 +937,7 @@ class Engine:
         old_lcap = carry["lpar"].shape[0]
         assert carry["vis"][0].shape[0] == vcap, \
             "grow the table via _rehash_tables first"
-        new = self._fresh_carry(lcap, vcap, self.FCAP)
+        new = self._fresh_carry(lcap, vcap, self.FCAP, self.OCAP)
         new["vis"] = carry["vis"]
         new["claims"] = carry["claims"]
         pad = lcap - old_lcap
@@ -982,9 +1018,9 @@ class Engine:
             res = CheckResult(distinct_states=0,
                               generated_states=n_roots, depth=0)
             self._check_pin_interiors(pin_interiors, res)
-            while self.LCAP - self.FCAP < 2 * n_roots:
+            while self.LCAP - self.OCAP < 2 * n_roots:
                 self.LCAP *= 2
-            while n_roots + self.LCAP - self.FCAP > \
+            while n_roots + self.LCAP - self.OCAP > \
                     self._LOAD_MAX * self.VCAP:
                 self.VCAP *= 4
             carry = self._fresh_carry(self.LCAP, self.VCAP)
@@ -1031,9 +1067,9 @@ class Engine:
 
         def grow_table_if_needed(carry):
             # pessimistic load bound: a level can add at most
-            # LCAP - FCAP keys, so checking before the level needs no
+            # LCAP - OCAP keys, so checking before the level needs no
             # mid-level sync
-            need = n_vis + self.LCAP - self.FCAP
+            need = n_vis + self.LCAP - self.OCAP
             if need > self._LOAD_MAX * self.VCAP:
                 while need > self._LOAD_MAX * self.VCAP:
                     self.VCAP *= 4
@@ -1099,21 +1135,27 @@ class Engine:
                 for _ in range(n_chunks):
                     carry = self._step_jit(carry, self.FAM_CAPS)
                 carry, out, scal = run_finalize(carry)
-                ovf, fovf, hovf = (bool(scal[4]), bool(scal[5]),
-                                   bool(scal[8]))
-                if not (ovf or fovf or hovf):
+                ovf, fovf, hovf, oovf = (bool(scal[4]), bool(scal[5]),
+                                         bool(scal[8]), bool(scal[9]))
+                if not (ovf or fovf or hovf or oovf):
                     break
                 # buffer overflow: the finalize rolled the table back
                 # and skipped its commit on device (frontier intact),
                 # so grow and replay the level exactly.  Growth is 4x —
                 # each growth step recompiles the fused kernels, so
                 # fewer, larger steps.
-                old_caps = (self.LCAP, self.FCAP)
+                old_caps = (self.LCAP, self.FCAP, self.OCAP)
+                if oovf:
+                    # a chunk's FRESH rows outran the post-dedup
+                    # compaction buffer; the true need is unknown (the
+                    # revert fired first), so double toward FCAP
+                    self.OCAP = self._round_cap(
+                        min(self.FCAP, 2 * self.OCAP))
                 if fovf:
                     # grow exactly the overflowing family caps (famx in
                     # the scal tail); grow FCAP only if the TOTAL
                     # enabled count blew the compaction buffer
-                    famx = scal[9:9 + len(self.FAM_CAPS)]
+                    famx = scal[11:11 + len(self.FAM_CAPS)]
                     caps = list(self.FAM_CAPS)
                     fam_over = False
                     for fi, fam in enumerate(self.expander.families):
@@ -1134,10 +1176,13 @@ class Engine:
                             self.chunk * self.A,
                             max(2 * self.FCAP,
                                 (5 * int(sum(famx))) // 4)))
-                if ovf or self.LCAP < 4 * self.FCAP:
+                if ovf or self.LCAP < 4 * self.OCAP:
+                    # the append margin is OCAP now, so the LCAP floor
+                    # couples to OCAP (an FCAP growth alone no longer
+                    # forces a level-buffer rebuild)
                     self.LCAP = self._round_cap(
                         max((4 * self.LCAP) if ovf else self.LCAP,
-                            4 * self.FCAP))
+                            4 * self.OCAP))
                 if hovf:
                     # probe walk blew its round budget: table too full
                     self.VCAP *= 4
@@ -1146,10 +1191,11 @@ class Engine:
                     carry = dict(carry, vis=vis, claims=claims)
                 if verbose:
                     print(f"level {depth}: buffer overflow "
-                          f"(ovf={ovf} fovf={fovf} hovf={hovf}), "
-                          f"LCAP={self.LCAP} FCAP={self.FCAP} "
+                          f"(ovf={ovf} fovf={fovf} hovf={hovf} "
+                          f"oovf={oovf}), LCAP={self.LCAP} "
+                          f"FCAP={self.FCAP} OCAP={self.OCAP} "
                           f"VCAP={self.VCAP}")
-                if (self.LCAP, self.FCAP) != old_caps:
+                if (self.LCAP, self.FCAP, self.OCAP) != old_caps:
                     carry = self._grow(carry, self.LCAP, self.VCAP)
                     # the replayed level can now add up to the NEW
                     # LCAP - FCAP keys: re-check the table load bound
@@ -1157,6 +1203,12 @@ class Engine:
                     # probe walk to its round budget)
                     carry = grow_table_if_needed(carry)
             n_front = harvest(carry, out, scal)
+            # per-family enabled maxima ride the scal tail every level;
+            # keep the run-wide max as cap-sizing diagnostics
+            # (tools/tune_config3.py reads this to pre-size FAM_CAPS)
+            self.famx_max = [max(a, b) for a, b in zip(
+                getattr(self, "famx_max", [0] * len(self.FAM_CAPS)),
+                scal[11:11 + len(self.FAM_CAPS)])]
             if scal[0] == 0 and scal[6] == 0:
                 # the frontier had only constraint-pruned rows: nothing
                 # was even generated, so this is not a BFS level (the
@@ -1223,23 +1275,26 @@ class Engine:
                    self._lanes, self._states, res, dict(
                        depth=depth, n_states=n_states, n_vis=n_vis,
                        n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
-                       FCAP=self.FCAP, fam_caps=list(self.FAM_CAPS),
+                       FCAP=self.FCAP, OCAP=self.OCAP,
+                       fam_caps=list(self.FAM_CAPS),
                        layout=2, chunk=self.chunk, cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
-                            ("LCAP", "VCAP", "FCAP", "fam_caps"),
+                            ("LCAP", "VCAP", "FCAP", "OCAP",
+                             "fam_caps"),
                             sharded=False, expected_format=(
                                 "layout", 2, "this engine's batch-last/"
                                 "narrow-dtype storage layout"))
-        self.LCAP, self.VCAP, self.FCAP = (meta["LCAP"], meta["VCAP"],
-                                           meta["FCAP"])
+        self.LCAP, self.VCAP, self.FCAP, self.OCAP = (
+            meta["LCAP"], meta["VCAP"], meta["FCAP"], meta["OCAP"])
         self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])
         # eval_shape: the template is only read for structure/key paths,
         # never materialized (a real _fresh_carry would transiently
         # double device memory at resume)
         template = jax.eval_shape(
-            lambda: self._fresh_carry(self.LCAP, self.VCAP, self.FCAP))
+            lambda: self._fresh_carry(self.LCAP, self.VCAP, self.FCAP,
+                                      self.OCAP))
         carry = ckpt_carry(path, z, template, jnp.asarray)
         self._parents, self._lanes, self._states = ckpt_archives(
             z, meta, template, self.store_states)

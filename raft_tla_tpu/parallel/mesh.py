@@ -47,9 +47,14 @@ principle pick different representatives — measured on the reference
 cfg micro-bounds at depth 16, content-min agrees with the oracle
 exactly (82,771 distinct; the arrival-rank scheme it replaced
 measured 82,751) — and each is deterministic and explores a sound
-constraint semantics.  Witness provenance (parent/lane of a surviving
-row) among equal-CONTENT candidates remains arrival-order and may
-differ across mesh shapes; counts cannot.
+constraint semantics.  Witness provenance is mesh-invariant too
+(VERDICT r4 #9): among equal-content candidates the canonical min
+extends to (parent fingerprint, lane) — the parent's FINGERPRINT, not
+its global id, because gids are assigned device-major and therefore
+differ across mesh shapes while the fingerprint is a pure function of
+the parent's content.  A violation trace reproduced on D=4 is
+action-by-action identical to the D=8 trace
+(tests/test_sharded.py::test_sharded_trace_mesh_invariant).
 """
 
 from __future__ import annotations
@@ -85,12 +90,15 @@ from ..models.raft import init_state
 from ..ops.codec import C_OVERFLOW, NONVIEW_KEYS, decode, encode, \
     narrow, widen
 
-# sharded checkpoint format gate (shared with MultiHostEngine): format
-# 2 = the round-4 content-canonical carry (adds the lrow table);
-# pre-change checkpoints read as format 1 and fail with this message
-# instead of a missing-leaf error deep in ckpt_carry
-_SHARDED_FMT = ("ckpt_format", 2,
-                "the carry gained the content-canonical lrow table")
+# sharded checkpoint format gate (shared with MultiHostEngine):
+# format 2 added the content-canonical lrow table (round 4); format 3
+# added the mesh-invariant provenance lpfp table (round 5).  Older
+# checkpoints fail here with a version message instead of a
+# missing-leaf error deep in ckpt_carry.
+_SHARDED_CKPT_FORMAT = 3
+_SHARDED_FMT = ("ckpt_format", _SHARDED_CKPT_FORMAT,
+                "the carry gained the mesh-invariant provenance "
+                "lpfp table")
 
 
 class ShardedEngine(Engine):
@@ -226,6 +234,13 @@ class ShardedEngine(Engine):
             self.fpr.fingerprint_batch(cand_c))            # [FC, W]
         pgid = c["pg_off"] + base + take // A
         lane = take % A
+        # parent fingerprints, for mesh-invariant provenance (module
+        # docstring): the canonical tiebreak among equal-content
+        # candidates must not use pgid — global ids are device-major
+        # and mesh-shape dependent — so the parent's content hash rides
+        # along instead (B extra hashes per step vs FC candidate ones)
+        pfp_par = self.fpr.fingerprint_batch(sv)           # [B, W]
+        pfp = pfp_par[take // A]                           # [FC, W]
 
         # ---- route to owner device (hash-ownership, SURVEY §2.14) ----
         owner = jnp.where(elive, (fp[:, W - 1] % D).astype(jnp.int32), D)
@@ -255,9 +270,10 @@ class ShardedEngine(Engine):
                                      for k, v in cand_c.items()})
         send_pgid = jnp.where(sfill, pgid[stake], -1)
         send_lane = jnp.where(sfill, lane[stake], -1)
-        (send_key, send_row, send_pgid, send_lane) = \
+        send_pfp = jnp.where(sfill[:, None], pfp[stake], U32MAX)
+        (send_key, send_row, send_pgid, send_lane, send_pfp) = \
             lax.optimization_barrier(
-                (send_key, send_row, send_pgid, send_lane))
+                (send_key, send_row, send_pgid, send_lane, send_pfp))
 
         a2a = partial(lax.all_to_all, axis_name="d", split_axis=0,
                       concat_axis=0, tiled=True)
@@ -265,6 +281,7 @@ class ShardedEngine(Engine):
         recv_row = {k: a2a(v) for k, v in send_row.items()}
         recv_pgid = a2a(send_pgid)
         recv_lane = a2a(send_lane)
+        recv_pfp = a2a(send_pfp)                            # [M, W]
 
         # ---- owner-side dedup: claim-insert into the table shard ----
         VB = c["vis"][0].shape[0]
@@ -297,7 +314,14 @@ class ShardedEngine(Engine):
             return ws
 
         cwords = content_words(recv_row)
-        ops = list(recv_key) + cwords + \
+        # provenance words extend the canonical key (module docstring):
+        # among equal (key, content) candidates the rep is the one with
+        # the smallest (parent fingerprint, lane) — mesh-invariant,
+        # unlike arrival order.  -1 lanes cast to 0xFFFFFFFF and sort
+        # last, so invalid rows never win a run.
+        pwords = [recv_pfp[:, w] for w in range(W)] + \
+            [recv_lane.astype(jnp.uint32)]
+        ops = list(recv_key) + cwords + pwords + \
             [jnp.arange(M, dtype=jnp.uint32)]
         srt = lax.sort(tuple(ops), num_keys=len(ops))
         s_idx = srt[-1].astype(jnp.int32)
@@ -345,6 +369,8 @@ class ShardedEngine(Engine):
             c["lpar"], recv_pgid[lidx], start, 0)
         llane = lax.dynamic_update_slice_in_dim(
             c["llane"], recv_lane[lidx], start, 0)
+        lpfp = lax.dynamic_update_slice(
+            c["lpfp"], recv_pfp[lidx], (start, 0))
         jslot = lax.dynamic_update_slice_in_dim(
             c["jslot"], pos[lidx], start, 0)
         linv = lax.dynamic_update_slice(c["linv"], inv, (start, 0))
@@ -356,16 +382,22 @@ class ShardedEngine(Engine):
         # inserts (reset to -1 at every level boundary/replay).  Rows
         # are disjoint across lanes (one rep per key per window), so
         # the scatters race-free; a replaced row keeps its jslot.
+        # The comparison key is (content, parent fp, lane) — the same
+        # extended canonical key stage 1 uses, so the level-wide min
+        # covers provenance too (mesh-invariant witness traces).
         lrow = c["lrow"].at[jnp.where(fresh, pos, VB)].set(
             (start + lpos).astype(jnp.int32), mode="drop")
         dup = live_rep & ~fresh & ~ovf_now
         tgt = lrow[jnp.clip(pos, 0, VB - 1)]
         dup = dup & (tgt >= 0)
         tgt_c = jnp.clip(tgt, 0, LB - 1)
-        swords = content_words({k: lvl[k][tgt_c] for k in lvl})
+        swords = content_words({k: lvl[k][tgt_c] for k in lvl}) + \
+            [lpfp[tgt_c, w] for w in range(W)] + \
+            [llane[tgt_c].astype(jnp.uint32)]
+        cand_words = cwords + pwords
         less = jnp.zeros((M,), bool)
         eq = jnp.ones((M,), bool)
-        for cw, sw in zip(cwords, swords):
+        for cw, sw in zip(cand_words, swords):
             less = less | (eq & (cw < sw))
             eq = eq & (cw == sw)
         repl = dup & less
@@ -374,11 +406,12 @@ class ShardedEngine(Engine):
                for k, v in lvl.items()}
         lpar = lpar.at[widx2].set(recv_pgid, mode="drop")
         llane = llane.at[widx2].set(recv_lane, mode="drop")
+        lpfp = lpfp.at[widx2].set(recv_pfp, mode="drop")
         linv = linv.at[widx2].set(inv_all, mode="drop")
         lcon = lcon.at[widx2].set(con_all, mode="drop")
         return dict(c, vis=table, claims=claims, lvl=lvl, lpar=lpar,
-                    llane=llane, jslot=jslot, linv=linv, lcon=lcon,
-                    lrow=lrow,
+                    llane=llane, lpfp=lpfp, jslot=jslot, linv=linv,
+                    lcon=lcon, lrow=lrow,
                     n_lvl=jnp.minimum(c["n_lvl"] + n_fresh, LB - M),
                     n_gen=n_gen, ovf=ovf, fovf=fovf, sovf=sovf,
                     hovf=hovf, famx=famx, base=base + B)
@@ -463,6 +496,9 @@ class ShardedEngine(Engine):
             lvl=zeros,
             lpar=jnp.full((D, LB), -1, jnp.int32),
             llane=jnp.full((D, LB), -1, jnp.int32),
+            # per-row parent fingerprint: the mesh-invariant half of
+            # the provenance key (stage-2 comparisons read it back)
+            lpfp=jnp.full((D, LB, self.W), U32MAX),
             cidx=jnp.zeros((D, FC), jnp.int32),
             # shape anchor for SC: jit caches on input avals, and SC
             # otherwise only shapes internal send/recv buffers — an SC
@@ -773,7 +809,7 @@ class ShardedEngine(Engine):
                 "multi-process runs")
         ckpt_write(path, carry, self.store_states, self._parents,
                    self._lanes, self._states, res, dict(
-                       sharded=True, ckpt_format=2, D=self.D,
+                       sharded=True, ckpt_format=_SHARDED_CKPT_FORMAT, D=self.D,
                        chunk=self.chunk,
                        LB=self.LB, VB=self.VB, FC=self.FC, SC=self.SC,
                        fam_caps=list(self.FAM_CAPS),

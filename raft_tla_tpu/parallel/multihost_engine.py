@@ -13,7 +13,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config import ModelConfig
 from ..engine.bfs import CheckpointError, ckpt_carry, ckpt_read, \
     ckpt_result, ckpt_write
-from .mesh import ShardedEngine
+from .mesh import ShardedEngine, _SHARDED_CKPT_FORMAT
 
 
 class MultiHostEngine(ShardedEngine):
@@ -244,7 +244,7 @@ class MultiHostEngine(ShardedEngine):
             jax.tree_util.tree_structure(carry), blocks)
         ckpt_write(self._proc_path(path), carry_local, False, [], [],
                    [], res, dict(
-                       sharded=True, ckpt_format=2, multihost=True,
+                       sharded=True, ckpt_format=_SHARDED_CKPT_FORMAT, multihost=True,
                        D=self.D, n_proc=jax.process_count(),
                        proc=jax.process_index(), d_idx=d_idx,
                        chunk=self.chunk, LB=self.LB, VB=self.VB,

@@ -64,8 +64,46 @@ def test_floor_ratchets_on_new_best(tmp_path):
         "best_states_per_sec"] == 60000.0
 
 
-def test_repo_floor_file_is_valid():
-    fl = json.load(open(os.path.join(REPO, "BENCH_FLOOR.json")))
-    e = fl["tlc_membership_S3_T3_L3"]
+import pytest
+
+_FLOOR_KEYS = sorted(k for k in json.load(
+    open(os.path.join(REPO, "BENCH_FLOOR.json"))) if k[0] != "_")
+
+
+def test_floor_covers_every_measured_config():
+    """VERDICT r4 #6: the configs rounds 3-5 fought for must each have
+    a regression floor — a 3x collapse on any of them must not ship
+    green via the headline row alone."""
+    want = {"tlc_membership_S3_T3_L3", "config1_budgeted",
+            "config2_budgeted", "config3_budgeted", "config4_budgeted",
+            "config5_budgeted", "spill_config2_depth19"}
+    assert want <= set(_FLOOR_KEYS), sorted(want - set(_FLOOR_KEYS))
+
+
+@pytest.mark.parametrize("key", _FLOOR_KEYS)
+def test_repo_floor_rows_are_valid(key):
+    e = json.load(open(os.path.join(REPO, "BENCH_FLOOR.json")))[key]
     assert 0 < e["hard_frac"] < e["warn_frac"] < 1
     assert e["best_states_per_sec"] > 0
+    assert e["platform_prefix"] and e["source"]
+
+
+@pytest.mark.parametrize("key", _FLOOR_KEYS)
+def test_floor_machinery_per_row(key, tmp_path):
+    """Every row works through the same warn/hard/ratchet machinery."""
+    p = tmp_path / "floor.json"
+    p.write_text(json.dumps({key: {
+        "platform_prefix": "TPU", "machine": "test",
+        "best_states_per_sec": 100000.0, "source": "test",
+        "warn_frac": 0.6, "hard_frac": 0.3}}))
+    fp = str(p)
+    info, zero = bench.perf_floor(45000.0, 0, "TPU v5", fp, key=key,
+                                  headline_depth=0)
+    assert info["status"] == "warn" and not zero
+    info, zero = bench.perf_floor(10000.0, 0, "TPU v5", fp, key=key,
+                                  headline_depth=0)
+    assert info["status"] == "hard" and zero
+    info, zero = bench.perf_floor(103000.0, 0, "TPU v5", fp, key=key,
+                                  headline_depth=0, bump_source="t")
+    assert info["status"] == "ok"
+    assert json.load(open(fp))[key]["best_states_per_sec"] == 103000.0

@@ -115,6 +115,27 @@ def test_sharded_reference_cfg_full_constraints():
     assert a.level_sizes == want.level_sizes
 
 
+def test_sharded_trace_mesh_invariant():
+    """VERDICT r4 #9: witness PROVENANCE is mesh-invariant, not just
+    counts — the canonical survivor key extends to (parent
+    fingerprint, lane), so the same violation reproduced on a 4- and
+    an 8-device mesh (different chunk and window packings) replays an
+    action-by-action identical trace."""
+    cfg = MICRO.with_(invariants=("FirstCommit",))
+    chains = {}
+    for d in (4, 8):
+        eng = ShardedEngine(cfg, devices=jax.devices()[:d],
+                            chunk=16 * d, store_states=True)
+        got = eng.check(stop_on_violation=True)
+        assert got.violations, f"FirstCommit witness not found (D={d})"
+        chains[d] = eng.trace(got.violations[0].state_id)
+    labels4 = [lbl for lbl, _s in chains[4]]
+    labels8 = [lbl for lbl, _s in chains[8]]
+    assert labels4 == labels8
+    for (l4, s4), (l8, s8) in zip(chains[4], chains[8]):
+        assert s4 == s8, f"state divergence at {l4}"
+
+
 def test_sharded_violation_and_trace():
     """Scenario property through the sharded engine: find the
     FirstCommit witness and reconstruct its trace across device-major

@@ -143,6 +143,23 @@ def main():
             r.distinct_states ** 2 /
             2.0 ** ((128 if fp128 else 64) + 1)),
     }
+    # spill perf floor (VERDICT r4 #6): the canonical spill probe shape
+    # (config #2, depth-exact 19, SpillEngine, single session) guards
+    # the spill engine's rate the way bench.py guards the classic one
+    if (not flags["--classic"] and conf_no == 2 and depth == 19
+            and rec["depth_exact"] and not fp128 and not resume):
+        import jax
+
+        from bench import perf_floor
+        floor_info, _zero = perf_floor(
+            rec["states_per_sec"], 0,
+            str(jax.devices()[0].device_kind),
+            os.path.join(os.path.dirname(os.path.dirname(OUT)),
+                         "BENCH_FLOOR.json"),
+            gate_ok=rec["violations"] == 0, allow_bump=True,
+            key="spill_config2_depth19", headline_depth=0,
+            bump_source="deep_run.py spill probe auto-bump")
+        rec["perf_floor"] = floor_info
     if nat_rec is not None:
         rec["native"] = nat_rec
         rec["counts_match"] = (

@@ -69,12 +69,21 @@ BUDGET = {1: 2_000_000, 2: 2_400_000, 3: 1_500_000, 4: 10**9,
           5: 600_000}
 DEPTH = {4: 10}
 ENGINE_KW = {
-    1: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24),
-    2: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24),
-    # fcap pre-sized: the membership model averages ~20 enabled
-    # lanes/parent, so the default chunk*16 compaction buffer
-    # overflows mid-run (growth = ~100s replay+recompile)
-    3: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24, fcap=1 << 16),
+    # ocap=2^14 on the S=3 configs: the early nearly-all-fresh levels
+    # outgrow the chunk*4 fresh-row default (growth = replay the level)
+    1: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24, ocap=1 << 14),
+    2: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24, ocap=1 << 14),
+    # fcap/ocap/fam_caps pre-sized from measured per-family enabled
+    # maxima (tools/tune_config3.py famx_max + 25% headroom): the
+    # membership model averages ~20 enabled lanes/parent and its early
+    # levels are nearly all-fresh, so the density-table defaults both
+    # under-size (mid-run growth = ~100s replay+recompile) and
+    # over-size (every phase pays the buffer width) — measured
+    # 18.2k -> 31.2k states/s round-over-round on this config
+    3: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24, fcap=45056,
+            ocap=1 << 14,
+            fam_caps=(3584, 512, 3584, 2048, 3072, 2560, 1024, 8192,
+                      4608, 8192, 7680, 7680, 2048, 3072)),
     4: dict(chunk=1024, lcap=1 << 17, vcap=1 << 20),
     5: dict(chunk=512, lcap=1 << 20, vcap=1 << 23),
 }
@@ -104,7 +113,11 @@ def measure(n):
     }
     print(f"config {n} native: {out['native']}", flush=True)
 
-    eng = Engine(cfg, store_states=False, **ENGINE_KW[n])
+    kw = dict(ENGINE_KW[n])
+    fam_caps = kw.pop("fam_caps", None)
+    eng = Engine(cfg, store_states=False, **kw)
+    if fam_caps is not None:
+        eng.FAM_CAPS = tuple(fam_caps)
     t0 = time.time()
     eng.check(max_depth=min(2, depth))          # warm the jit caches
     compile_s = time.time() - t0
@@ -125,6 +138,19 @@ def measure(n):
         and out["native"]["depth"] == out["engine"]["depth"])
     out["speedup"] = round(out["engine"]["states_per_sec"] /
                            max(out["native"]["states_per_sec"], 1e-9), 2)
+    # per-config perf floor (VERDICT r4 #6): the canonical budgeted run
+    # checks + ratchets its BENCH_FLOOR row like bench.py's headline
+    import jax
+
+    from bench import perf_floor
+    floor_info, _zero = perf_floor(
+        out["engine"]["states_per_sec"], 0,
+        str(jax.devices()[0].device_kind),
+        os.path.join(os.path.dirname(OUT), "BENCH_FLOOR.json"),
+        gate_ok=out["counts_match"], allow_bump=True,
+        key=f"config{n}_budgeted", headline_depth=0,
+        bump_source=f"measure_baseline.py config {n} auto-bump")
+    out["engine"]["perf_floor"] = floor_info
     print(f"config {n} engine: {out['engine']} "
           f"match={out['counts_match']} speedup={out['speedup']}",
           flush=True)
