@@ -1027,33 +1027,30 @@ class Engine:
             # roots enter through the same admit path as every level:
             # place them in the level buffer + visited table (host-side
             # probe placement — the table is empty, so the sequential
-            # simulation is exact) and finalize.
-            pad = self.LCAP - n_roots
+            # simulation is exact) and finalize.  Only the n_roots rows
+            # cross the tunnel: the buffers stay device-resident and
+            # take the rows via .at[] updates — the previous host-side
+            # concatenate-then-upload shipped the WHOLE padded LCAP
+            # buffer (~340 B/row x millions of rows at ~50 MB/s, tens
+            # of seconds of "warm start" per check() call).
             roots_n = {k: np.moveaxis(v, 0, -1) for k, v in
                        narrow(self.lay, widen(roots)).items()}
-            carry["lvl"] = {k: jnp.asarray(np.concatenate(
-                [roots_n[k], np.zeros(roots_n[k].shape[:-1] + (pad,),
-                                      roots_n[k].dtype)], axis=-1))
-                for k in roots_n}
+            carry["lvl"] = {
+                k: v.at[..., :n_roots].set(jnp.asarray(roots_n[k]))
+                for k, v in carry["lvl"].items()}
             slots = self._host_probe_assign(rk)
             sl = jnp.asarray(slots)
             carry["vis"] = tuple(
                 carry["vis"][w].at[sl].set(jnp.asarray(rk[:, w]))
                 for w in range(self.W))
-            jslot = np.full((self.LCAP,), -1, np.int32)
-            jslot[:n_roots] = slots
-            carry["jslot"] = jnp.asarray(jslot)
+            carry["jslot"] = carry["jslot"].at[:n_roots].set(sl)
             carry["n_lvl"] = jnp.int32(n_roots)
             # invariants/constraints for the root cohort (levels get
             # theirs inside the chunk step; roots bypass it)
             inv_r, con_r = self._phase2(
                 {k: jnp.asarray(roots[k]) for k in roots})
-            linv = np.ones((len(self.inv_names), self.LCAP), bool)
-            linv[:, :n_roots] = np.asarray(inv_r).T
-            lcon = np.ones((self.LCAP,), bool)
-            lcon[:n_roots] = np.asarray(con_r)
-            carry["linv"] = jnp.asarray(linv)
-            carry["lcon"] = jnp.asarray(lcon)
+            carry["linv"] = carry["linv"].at[:, :n_roots].set(inv_r.T)
+            carry["lcon"] = carry["lcon"].at[:n_roots].set(con_r)
             n_states = 0
             n_vis = 0
             depth = 0

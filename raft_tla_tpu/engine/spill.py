@@ -96,6 +96,7 @@ class SpillEngine(Engine):
         self.sync_every = max(1, int(sync_every))
         self._paste_cache = {}         # upload-paste jit per block size
         self._slice_cache = {}         # spill-slice jit per block size
+        self._ckpt_sparse_cache = {}   # sparse-table jit per size
         self._sstep_jit = jax.jit(self._spill_step_impl,
                                   donate_argnums=0, static_argnums=1)
 
@@ -703,22 +704,40 @@ class SpillEngine(Engine):
 
     def _save_spill_checkpoint(self, path, carry, res, frontier_blocks,
                                depth, n_states, n_vis):
-        # the table serializes SPARSE (occupied slot indices + keys):
-        # deep runs pre-allocate VCAP for the final level (2^28 slots =
-        # 4 GB/stream-pair at fp128), and a dense dump would write all
-        # of it every level.  Sparse is O(occupied) — the early-level
-        # checkpoints of an hours-scale run cost MBs, not GBs.  An
-        # all-ones key aliases "empty" and would drop out here — the
-        # same 2^-64/2^-128 accepted-risk class as the probe walk
-        # (engine/bfs table docstring).
-        vis_np = [np.asarray(t) for t in carry["vis"]]
-        empty = vis_np[0] == np.uint32(0xFFFFFFFF)
-        for t in vis_np[1:]:
-            empty &= t == np.uint32(0xFFFFFFFF)
-        occ_idx = np.nonzero(~empty)[0].astype(np.int64)
+        # the table serializes SPARSE (occupied slot indices + keys),
+        # and the sparsification runs ON DEVICE: deep runs pre-allocate
+        # VCAP for the final level (2^28 slots = 4 GB of streams at
+        # fp128), and fetching the dense table over the ~50 MB/s
+        # tunnel cost ~80 s per checkpoint (measured — it throttled
+        # every early level of the depth-21 fp128 run).  The device
+        # compacts occupied slots into a buffer quantized to the
+        # host-tracked occupancy (n_vis counts exactly the admitted
+        # keys), so the transfer is O(occupied).  An all-ones key
+        # aliases "empty" and would drop out — the same 2^-64/2^-128
+        # accepted-risk class as the probe walk (engine/bfs table
+        # docstring).
+        VCAP = self.VCAP
+        nq = self._quantize(max(n_vis, 1), VCAP)
+        fn = self._ckpt_sparse_cache.get((nq, VCAP))
+        if fn is None:
+            def impl(vis, nq=nq, VCAP=VCAP):
+                empty = vis[0] == U32MAX
+                for t in vis[1:]:
+                    empty &= t == U32MAX
+                idx = jnp.nonzero(~empty, size=nq,
+                                  fill_value=VCAP)[0]
+                safe = jnp.clip(idx, 0, VCAP - 1)
+                keys = jnp.stack([
+                    jnp.where(idx < VCAP, t[safe], U32MAX)
+                    for t in vis])
+                return idx.astype(jnp.int64), keys
+            fn = self._ckpt_sparse_cache[(nq, VCAP)] = jax.jit(impl)
+        idx_np, keys_np = (np.asarray(a) for a in fn(carry["vis"]))
+        live = idx_np < VCAP
+        occ_idx = idx_np[live]
         ckpt = dict(
             vis_idx=occ_idx,
-            vis_keys=np.stack([t[occ_idx] for t in vis_np]),
+            vis_keys=np.ascontiguousarray(keys_np[:, live]),
             fblk=[dict(g=np.asarray(g),
                        r={k: np.asarray(v) for k, v in rows.items()})
                   for rows, g in frontier_blocks])

@@ -92,13 +92,15 @@ from ..ops.codec import C_OVERFLOW, NONVIEW_KEYS, decode, encode, \
 
 # sharded checkpoint format gate (shared with MultiHostEngine):
 # format 2 added the content-canonical lrow table (round 4); format 3
-# added the mesh-invariant provenance lpfp table (round 5).  Older
-# checkpoints fail here with a version message instead of a
-# missing-leaf error deep in ckpt_carry.
-_SHARDED_CKPT_FORMAT = 3
+# added the mesh-invariant provenance lpfp table (round 5); format 4
+# replaced the pg_off arithmetic with the gids table and added
+# trip_base (round 5, the spill-composed engine).  Older checkpoints
+# fail here with a version message instead of a missing-leaf error
+# deep in ckpt_carry.
+_SHARDED_CKPT_FORMAT = 4
 _SHARDED_FMT = ("ckpt_format", _SHARDED_CKPT_FORMAT,
-                "the carry gained the mesh-invariant provenance "
-                "lpfp table")
+                "the carry replaced pg_off with the gids table and "
+                "gained trip_base")
 
 
 class ShardedEngine(Engine):
@@ -139,6 +141,9 @@ class ShardedEngine(Engine):
                                      2 * self.D * self.SC))
         # per-family materialization caps are per-DEVICE (chunk/D rows)
         self.FAM_CAPS = tuple(self.expander.default_fam_caps(self.BL))
+        # step-atomic trip discipline: off here (whole-level journal
+        # replay); the spill-composed subclass turns it on
+        self._step_atomic = False
         self._level_jit = jax.jit(self._sharded_level_call,
                                   donate_argnums=0, static_argnums=1)
 
@@ -229,10 +234,14 @@ class ShardedEngine(Engine):
             par_c = {k: v[take // A] for k, v in sv.items()}
             act = jax.vmap(self._act_ok)(par_c, cand_c)
             elive = elive & act
-        n_gen = c["n_gen"] + elive.sum(dtype=jnp.int32)
+        gen_inc = elive.sum(dtype=jnp.int32)
         fp = lax.optimization_barrier(
             self.fpr.fingerprint_batch(cand_c))            # [FC, W]
-        pgid = c["pg_off"] + base + take // A
+        # parent global ids come from the per-row gids table (the
+        # commit finalize refreshes it; the spill-composed engine
+        # uploads host-compacted frontiers where arithmetic ids are
+        # impossible)
+        pgid = c["gids"][base + take // A]
         lane = take % A
         # parent fingerprints, for mesh-invariant provenance (module
         # docstring): the canonical tiebreak among equal-content
@@ -291,7 +300,17 @@ class ShardedEngine(Engine):
         # include the CURRENT step's fovf/sovf (not just prior-step
         # flags): a step that overflowed its compaction or send buffer
         # is doomed to replay, so its claim-inserts are wasted writes
-        gate = ~(c["ovf"] | fovf | sovf | c["hovf"])
+        if self._step_atomic:
+            # spill-composed mode (parallel/spill_mesh): a tripping
+            # step must commit on NO device — the host resumes from
+            # the tripped step after spilling/growing, and there is no
+            # whole-level journal rollback once shard contents have
+            # spilled to host.  One tiny all_gather makes the
+            # pre-insert trip decision global.
+            pre_bad = jax.lax.all_gather(fovf | sovf, "d").any()
+        else:
+            pre_bad = fovf | sovf
+        gate = ~(c["ovf"] | pre_bad | c["hovf"])
 
         # ---- content-canonical survivor, stage 1 (VERDICT r3 #6) ----
         # The admitted representative among equal-fingerprint candidates
@@ -338,14 +357,36 @@ class ShardedEngine(Engine):
         hovf = c["hovf"] | hv
         n_fresh = fresh.sum(dtype=jnp.int32)
         ovf_now = c["n_lvl"] + n_fresh > LB - M
+        if self._step_atomic:
+            # spill-composed mode: revert on EVERY device when ANY
+            # device tripped, so the tripped step commits nowhere and
+            # the host can resume from trip_base exactly
+            bad_now = pre_bad | jax.lax.all_gather(ovf_now | hv,
+                                                   "d").any()
+            stepped = ~(c["ovf"] | c["fovf"] | c["sovf"] | c["hovf"])
+            trip_base = jnp.where(stepped & bad_now, base,
+                                  c["trip_base"])
+        else:
+            # classic mode: local revert; the whole-level journal
+            # rollback at finalize handles cross-device consistency
+            bad_now = ovf_now
+            trip_base = c["trip_base"]
         # level shard would overflow: revert this step's inserts and
         # skip the append (the level replays; see engine/bfs)
-        ridx2 = jnp.where(fresh & ovf_now, pos, VB)
+        ridx2 = jnp.where(fresh & bad_now, pos, VB)
         table = tuple(table[w].at[ridx2].set(U32MAX, mode="drop")
                       for w in range(W))
-        fresh = fresh & ~ovf_now
-        n_fresh = jnp.where(ovf_now, 0, n_fresh)
+        fresh = fresh & ~bad_now
+        n_fresh = jnp.where(bad_now, 0, n_fresh)
         ovf = c["ovf"] | ovf_now
+        if self._step_atomic:
+            # a tripped step replays from trip_base: count its
+            # generated successors only when it commits
+            n_gen = c["n_gen"] + jnp.where(gate & ~bad_now, gen_inc, 0)
+        else:
+            # classic mode: the whole-level replay resets n_gen at the
+            # finalize, so the unconditional count is exact
+            n_gen = c["n_gen"] + gen_inc
 
         ridx = jnp.arange(M, dtype=jnp.int32)
         lpos = jnp.where(fresh,
@@ -387,7 +428,7 @@ class ShardedEngine(Engine):
         # covers provenance too (mesh-invariant witness traces).
         lrow = c["lrow"].at[jnp.where(fresh, pos, VB)].set(
             (start + lpos).astype(jnp.int32), mode="drop")
-        dup = live_rep & ~fresh & ~ovf_now
+        dup = live_rep & ~fresh & ~bad_now
         tgt = lrow[jnp.clip(pos, 0, VB - 1)]
         dup = dup & (tgt >= 0)
         tgt_c = jnp.clip(tgt, 0, LB - 1)
@@ -414,7 +455,8 @@ class ShardedEngine(Engine):
                     lcon=lcon, lrow=lrow,
                     n_lvl=jnp.minimum(c["n_lvl"] + n_fresh, LB - M),
                     n_gen=n_gen, ovf=ovf, fovf=fovf, sovf=sovf,
-                    hovf=hovf, famx=famx, base=base + B)
+                    hovf=hovf, famx=famx, trip_base=trip_base,
+                    base=base + B)
 
     # -----------------------------------------------------------------
 
@@ -440,10 +482,16 @@ class ShardedEngine(Engine):
         total = nl_vec.sum()
 
         def commit(c):
-            # the level's keys are already in the table shard
+            # the level's keys are already in the table shard; the
+            # swapped-in frontier rows' global ids are device-major
+            # arithmetic, materialized into the gids table here so the
+            # step can read ids uniformly (host-compacted frontiers in
+            # the spill-composed engine upload theirs instead)
             fmask = con & validrow
+            gids = c["g_off"] + prefix[d_idx] + \
+                jnp.arange(LB, dtype=jnp.int32)
             return (c["lvl"], c["front"], fmask, n_lvl, c["vis"],
-                    c["g_off"] + prefix[d_idx], c["g_off"] + total)
+                    gids, c["g_off"] + total)
 
         def abandon(c):
             # roll the table shard back via the journal (engine/bfs
@@ -452,9 +500,9 @@ class ShardedEngine(Engine):
             vis = tuple(c["vis"][w].at[cidx].set(U32MAX, mode="drop")
                         for w in range(self.W))
             return (c["front"], c["lvl"], c["fmask"], c["n_front"],
-                    vis, c["pg_off"], c["g_off"])
+                    vis, c["gids"], c["g_off"])
 
-        front, lvl, fmask, n_front, vis, pg_off, g_next = lax.cond(
+        front, lvl, fmask, n_front, vis, gids, g_next = lax.cond(
             bad, abandon, commit, c)
         # [D, 10+n_fams] replicated via all_gather so every controller
         # process reads the full matrix (multi-host safe; out_specs
@@ -474,7 +522,8 @@ class ShardedEngine(Engine):
                      # slot->level-row map is per-level (commit moves to
                      # the next level; abandon replays this one)
                      lrow=jnp.full_like(c["lrow"], -1),
-                     base=jnp.int32(0), pg_off=pg_off, g_off=g_next)
+                     trip_base=jnp.int32(-1),
+                     base=jnp.int32(0), gids=gids, g_off=g_next)
         return new_c, dict(inv_ok=inv_ok, scal=scal)
 
     # -----------------------------------------------------------------
@@ -499,6 +548,10 @@ class ShardedEngine(Engine):
             # per-row parent fingerprint: the mesh-invariant half of
             # the provenance key (stage-2 comparisons read it back)
             lpfp=jnp.full((D, LB, self.W), U32MAX),
+            # per-frontier-row global ids (refreshed by the commit
+            # finalize; uploaded by the spill-composed engine)
+            gids=jnp.full((D, LB), -1, jnp.int32),
+            trip_base=jnp.full((D,), -1, jnp.int32),
             cidx=jnp.zeros((D, FC), jnp.int32),
             # shape anchor for SC: jit caches on input avals, and SC
             # otherwise only shapes internal send/recv buffers — an SC
@@ -509,7 +562,6 @@ class ShardedEngine(Engine):
             famx=jnp.zeros((D, len(self.expander.families)), jnp.int32),
             base=jnp.zeros((D,), jnp.int32),
             g_off=jnp.zeros((D,), jnp.int32),
-            pg_off=jnp.zeros((D,), jnp.int32),
             ovf=jnp.zeros((D,), bool),
             fovf=jnp.zeros((D,), bool),
             sovf=jnp.zeros((D,), bool),
@@ -581,6 +633,13 @@ class ShardedEngine(Engine):
                     carry_np["linv"][d, r] = inv_r[i]
                     carry_np["lcon"][d, r] = con_r[i]
                 nl[d] = len(per_dev[d])
+            # root global ids, device-major (the finalize commit swaps
+            # lvl->front and recomputes gids the same way; seeding them
+            # here keeps the seed finalize's abandon-path gids sane)
+            pref = np.cumsum(nl) - nl
+            for d in range(D):
+                carry_np["gids"][d, :nl[d]] = pref[d] + \
+                    np.arange(nl[d], dtype=np.int32)
                 rkd = rk[per_dev[d]]                       # [n, W]
                 # host-side probe placement into the empty table shard
                 slots = self._host_probe_assign(rkd, vcap=self.VB)
@@ -786,7 +845,11 @@ class ShardedEngine(Engine):
             [old["fmask"], jnp.zeros((D, pad), bool)], axis=1)
         new["n_front"] = old["n_front"]
         new["g_off"] = old["g_off"]
-        new["pg_off"] = old["pg_off"]
+        # gids ride with the frontier rows they describe
+        olb2 = old["gids"].shape[1]
+        new["gids"] = jnp.concatenate(
+            [old["gids"], jnp.full((D, self.LB - olb2), -1,
+                                   jnp.int32)], axis=1)
         return new
 
     # ------------------------------------------------------------------

@@ -1,0 +1,472 @@
+"""Spill-composed sharded BFS: the mesh scale-out story and the host-
+spill depth story in ONE engine (VERDICT r4 #5).
+
+The classic ShardedEngine (parallel/mesh) keeps each device's frontier
+and level shard device-resident, so a real mesh hits the same per-chip
+level-buffer wall the single-device SpillEngine (engine/spill) broke;
+and the SpillEngine is single-device.  TLC's distributed mode has one
+story for both — every worker spills its local queue to disk.  This
+engine is that composition, TPU-shaped:
+
+- per-device visited-table shards stay device-resident (hash-ownership
+  dedup over ``all_to_all`` exactly as in parallel/mesh — ownership is
+  fingerprint-derived, which is ALSO the spill partition key, so
+  routing is unchanged);
+- each device's FRONTIER lives in host RAM as per-device blocks and
+  streams through its [D, LB] shard in segments (quantized H2D);
+- each device's LEVEL shard spills to host when full and at level
+  ends (quantized D2H), becoming the next per-device frontier blocks;
+- trips are STEP-ATOMIC (mesh._local_step's _step_atomic mode): a
+  step that overflows any shard commits on NO device — one small
+  all_gather makes the trip decision global — so the host can spill /
+  grow and resume from the tripped step exactly.  The whole-level
+  journal replay of the classic engine is impossible here: earlier
+  shard contents have already left the device.
+
+Survivor policy: stage-1 content-canonical reduction per receive
+window is unchanged; the stage-2 replace-if-smaller map (lrow) only
+reaches rows still ON the device, so the canonical min is per SPILL
+EPOCH (first-epoch-seen across epochs).  When no mid-level spill
+occurs this engine is bit-identical to ShardedEngine; with mid-level
+spills counts remain fully deterministic for a fixed (mesh, seg)
+configuration, and on VIEW-only constraint sets (where the
+representative's non-VIEW content cannot affect reachability) counts
+equal the oracle exactly regardless of spill timing
+(tests/test_spill_mesh.py forces spills every few steps and pins
+oracle parity).  Constraint semantics stay prune-not-expand: pruned
+rows are counted, checked and dropped host-side (engine/spill's
+policy, differentially tested).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import ModelConfig
+from ..engine.bfs import CheckResult, U32MAX, Violation
+from ..engine.spill import SpillEngine
+from ..models.raft import init_state
+from ..ops.codec import C_OVERFLOW, decode, encode, narrow
+from .mesh import P, ShardedEngine, _shard_map
+
+# summary row layout ([D, Z_LEN + n_fams] int32, replicated)
+(Z_NLVL, Z_NGEN, Z_OVF, Z_FOVF, Z_SOVF, Z_HOVF, Z_TRIP,
+ Z_LEN) = range(8)
+
+
+class SpilledShardedEngine(ShardedEngine):
+    """ShardedEngine whose level/frontier shards stream through host
+    RAM (module docstring).  ``lcap`` is the MESH-TOTAL level
+    capacity, split evenly across devices (LB = lcap/D rows per shard,
+    floored by the receive-window bound) — the same convention as
+    ShardedEngine; everything else follows it too."""
+
+    def __init__(self, cfg: ModelConfig, devices=None, chunk: int = 512,
+                 store_states: bool = False, **kw):
+        if store_states:
+            raise NotImplementedError(
+                "SpilledShardedEngine does not archive states yet — "
+                "run ShardedEngine (store_states) within its depth "
+                "range, or SpillEngine single-device")
+        super().__init__(cfg, devices=devices, chunk=chunk,
+                         store_states=False, **kw)
+        # the classic engine's LB >= 4*FC floor is a thrash heuristic
+        # for whole-level replays; this engine replays only single
+        # steps, so the shard capacity honors the caller's lcap down
+        # to the hard receive-window bound (LB > D*SC) — tests squeeze
+        # it far below the widest level to force mid-level spills
+        self.LB = self._round_lb(max(kw.get("lcap", 1 << 14) // self.D,
+                                     2 * self.D * self.SC))
+        self._step_atomic = True      # read at first trace of the step
+        self.mid_level_spills = 0     # diagnostics: ovf-trip spills
+        self._sseg_jit = jax.jit(self._spill_seg_call,
+                                 donate_argnums=0, static_argnums=1)
+        self._mslice_cache = {}
+        self._mpaste_cache = {}
+
+    # -- device programs ----------------------------------------------
+
+    def _spill_seg_call(self, carry, fam_caps):
+        specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
+        out_specs = (specs, P(None))
+        return _shard_map(
+            lambda c: self._spill_seg_level(c, fam_caps), self.mesh,
+            (specs,), out_specs)(carry)
+
+    def _spill_seg_level(self, carry, fam_caps):
+        """Run lock-step chunk steps until every device drained its
+        frontier segment or any device tripped; report the summary
+        matrix WITHOUT the classic finalize (no lvl->front swap — the
+        host owns level assembly here)."""
+        c = jax.tree_util.tree_map(lambda x: x[0], carry)
+
+        def cond(c):
+            more = c["base"] < c["n_front"]
+            bad = c["ovf"] | c["fovf"] | c["sovf"] | c["hovf"]
+            flags = jax.lax.all_gather(jnp.stack([more, bad]), "d")
+            return flags[:, 0].any() & ~flags[:, 1].any()
+
+        c = lax.while_loop(cond,
+                           lambda cc: self._local_step(cc, fam_caps), c)
+        summ = jax.lax.all_gather(jnp.concatenate([jnp.stack([
+            c["n_lvl"], c["n_gen"],
+            c["ovf"].astype(jnp.int32), c["fovf"].astype(jnp.int32),
+            c["sovf"].astype(jnp.int32), c["hovf"].astype(jnp.int32),
+            c["trip_base"]]), c["famx"]]), "d")
+        return (jax.tree_util.tree_map(lambda x: x[None], c), summ)
+
+    # -- host-side shard plumbing -------------------------------------
+
+    def _fetch_shards(self, carry, nl: np.ndarray):
+        """D2H of every device's filled level-shard rows (one
+        quantized jit'd slice — fresh buffers, donation-safe), plus
+        reset of the per-level device state.  Returns per-device blocks
+        [(rows batch-major narrow, lpar, llane, linv, lcon, n)]."""
+        blks = [None] * self.D
+        nmax = int(nl.max())
+        if nmax > 0:
+            nq = SpillEngine._quantize(nmax, self.LB, floor=1 << 8)
+            fn = self._mslice_cache.get(nq)
+            if fn is None:
+                def impl(lvl, lpar, llane, linv, lcon, nq=nq):
+                    return (
+                        {k: lax.slice_in_dim(v, 0, nq, axis=1)
+                         for k, v in lvl.items()},
+                        lax.slice_in_dim(lpar, 0, nq, axis=1),
+                        lax.slice_in_dim(llane, 0, nq, axis=1),
+                        lax.slice_in_dim(linv, 0, nq, axis=1),
+                        lax.slice_in_dim(lcon, 0, nq, axis=1))
+                fn = self._mslice_cache[nq] = jax.jit(impl)
+            lvl, lpar, llane, linv, lcon = jax.tree_util.tree_map(
+                np.asarray,
+                fn(carry["lvl"], carry["lpar"], carry["llane"],
+                   carry["linv"], carry["lcon"]))
+            for d in range(self.D):
+                n = int(nl[d])
+                if n:
+                    blks[d] = dict(
+                        rows={k: np.ascontiguousarray(v[d, :n])
+                              for k, v in lvl.items()},
+                        lpar=np.ascontiguousarray(lpar[d, :n]),
+                        llane=np.ascontiguousarray(llane[d, :n]),
+                        linv=np.ascontiguousarray(linv[d, :n]),
+                        lcon=np.ascontiguousarray(lcon[d, :n]),
+                        n=n)
+        # reset the per-level device state.  lrow reset closes the
+        # stage-2 replacement epoch (module docstring): replacements
+        # must never target rows that just left the device.
+        carry["n_lvl"] = jnp.zeros((self.D,), jnp.int32)
+        carry["lrow"] = jnp.full((self.D, self.VB), -1, jnp.int32)
+        return carry, blks
+
+    def _upload_seg(self, carry, seg):
+        """Quantized H2D of one frontier segment: seg is a per-device
+        list of (rows batch-major narrow, gids) or None."""
+        ns = [0 if s is None else int(s[1].shape[0]) for s in seg]
+        nq = SpillEngine._quantize(max(max(ns), 1), self.LB,
+                                  floor=1 << 8)
+        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
+        rows_np = {k: np.zeros((self.D, nq) + v.shape, v.dtype)
+                   for k, v in one.items()}
+        gids_np = np.full((self.D, nq), -1, np.int32)
+        for d, s in enumerate(seg):
+            if s is None:
+                continue
+            rows, gids = s
+            for k in rows_np:
+                rows_np[k][d, :ns[d]] = rows[k]
+            gids_np[d, :ns[d]] = gids
+        fn = self._mpaste_cache.get(nq)
+        if fn is None:
+            def impl(front, fgids, blocks, bg):
+                front = {k: lax.dynamic_update_slice(
+                    v, blocks[k], (0, 0) + (0,) * (v.ndim - 2))
+                    for k, v in front.items()}
+                return front, lax.dynamic_update_slice(fgids, bg, (0, 0))
+            fn = self._mpaste_cache[nq] = jax.jit(
+                impl, donate_argnums=(0, 1))
+        carry["front"], carry["gids"] = fn(
+            carry["front"], carry["gids"],
+            {k: jnp.asarray(v) for k, v in rows_np.items()},
+            jnp.asarray(gids_np))
+        carry["n_front"] = jnp.asarray(np.asarray(ns, np.int32))
+        carry["base"] = jnp.zeros((self.D,), jnp.int32)
+        # prune-not-expand ran host-side (pruned rows never uploaded),
+        # so every uploaded row is expandable; the step's fmask gate
+        # must not mask them (the classic engine uses fmask to keep
+        # pruned rows in place instead)
+        LB = carry["fmask"].shape[1]
+        carry["fmask"] = jnp.ones((self.D, LB), bool)
+        return carry
+
+    @staticmethod
+    def _resegment_dev(blocks_per_dev, seg: int):
+        """Per-device re-segmentation, lock-step across devices: yields
+        per-device [(rows, gids) or None] lists of <= seg rows."""
+        cursors = [list(b) for b in blocks_per_dev]
+        while any(cursors):
+            out = []
+            for d, q in enumerate(cursors):
+                take_rows, take_gids, have = [], [], 0
+                while q and have < seg:
+                    rows, gids = q[0]
+                    n = int(gids.shape[0])
+                    t = min(seg - have, n)
+                    take_rows.append({k: v[:t]
+                                      for k, v in rows.items()})
+                    take_gids.append(gids[:t])
+                    have += t
+                    if t == n:
+                        q.pop(0)
+                    else:
+                        q[0] = ({k: v[t:] for k, v in rows.items()},
+                                gids[t:])
+                if have:
+                    keys = take_rows[0].keys()
+                    out.append((
+                        {k: np.concatenate([r[k] for r in take_rows])
+                         for k in keys},
+                        np.concatenate(take_gids)))
+                else:
+                    out.append(None)
+            yield out
+
+    # -- the check loop -----------------------------------------------
+
+    def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
+              stop_on_violation: bool = False,
+              seed_states: Optional[List] = None,
+              checkpoint_path: Optional[str] = None,
+              checkpoint_every: int = 1,
+              resume_from: Optional[str] = None,
+              verbose: bool = False) -> CheckResult:
+        if checkpoint_path is not None or resume_from is not None:
+            raise NotImplementedError(
+                "SpilledShardedEngine does not checkpoint yet — use "
+                "ShardedEngine (device-resident) or SpillEngine "
+                "(single-device) for checkpointed runs")
+        assert jax.process_count() == 1, \
+            "single-controller engine (MultiHostEngine composition " \
+            "is future work)"
+        t0 = time.time()
+        lay = self.lay
+        D, W = self.D, self.W
+
+        # ---- roots: hash-owner placement into host blocks -----------
+        roots, rk, pin_interiors = self._dedup_roots(seed_states)
+        res = CheckResult(distinct_states=0, generated_states=len(rk),
+                          depth=0)
+        self._check_pin_interiors(pin_interiors, res)
+        per_dev: List[List[int]] = [[] for _ in range(D)]
+        for r in range(len(rk)):
+            per_dev[int(rk[r, W - 1]) % D].append(r)
+        inv_r, con_r = (np.asarray(a) for a in self._phase2(
+            {k: jnp.asarray(v) for k, v in roots.items()}))
+        roots_n = narrow(lay, roots)
+
+        carry = self._fresh_sharded_carry()
+        vis_np = [np.array(t) for t in carry["vis"]]   # writable copies
+        root_blks = [None] * D
+        for d in range(D):
+            idx = per_dev[d]
+            if not idx:
+                continue
+            rkd = rk[idx]
+            slots = self._host_probe_assign(rkd, vcap=self.VB)
+            for r, sl in enumerate(slots):
+                for w in range(W):
+                    vis_np[w][d, sl] = rkd[r, w]
+            root_blks[d] = dict(
+                rows={k: np.stack([np.asarray(roots_n[k][i])
+                                   for i in idx]) for k in roots_n},
+                lpar=np.full((len(idx),), -1, np.int32),
+                llane=np.full((len(idx),), -1, np.int32),
+                linv=inv_r[idx], lcon=con_r[idx], n=len(idx))
+        carry["vis"] = tuple(jnp.asarray(v) for v in vis_np)
+
+        n_states = 0
+        n_vis = np.array([len(p) for p in per_dev], np.int64)
+        depth = 0
+
+        def harvest_blocks(blks):
+            """Device-major harvest of one spill event's blocks:
+            counts, violations, next-frontier rows (pruned rows
+            dropped, prune-not-expand).  Returns per-device
+            (rows, gids) or None."""
+            nonlocal n_states
+            out = [None] * D
+            for d in range(D):
+                blk = blks[d]
+                if blk is None:
+                    continue
+                n = blk["n"]
+                res.distinct_states += n
+                res.overflow_faults += int(
+                    (blk["rows"]["ctr"][:, C_OVERFLOW] > 0).sum())
+                gids = np.arange(n_states, n_states + n,
+                                 dtype=np.int32)
+                inv_ok = blk["linv"]
+                if inv_ok.size and not inv_ok.all():
+                    bad = np.nonzero(~inv_ok)
+                    res.violations_global += len(bad[0])
+                    for s, j in zip(*bad):
+                        vsv, vh = decode(lay, {
+                            k: np.asarray(v[s])
+                            for k, v in blk["rows"].items()})
+                        res.violations.append(Violation(
+                            self.inv_names[j], int(gids[s]),
+                            state=vsv, hist=vh))
+                n_states += n
+                if n_states >= 2 ** 31 - 1:
+                    raise RuntimeError(
+                        "state-id space exhausted (2^31 ids)")
+                con = blk["lcon"].astype(bool)
+                if con.all():
+                    out[d] = (blk["rows"], gids)
+                elif con.any():
+                    keep = np.nonzero(con)[0]
+                    out[d] = ({k: v[keep]
+                               for k, v in blk["rows"].items()},
+                              gids[keep])
+            return out
+
+        frontier: List[List] = [[] for _ in range(D)]
+        root_front = harvest_blocks(root_blks)
+        for d in range(D):
+            if root_front[d] is not None:
+                frontier[d].append(root_front[d])
+        res.generated_states = len(rk)
+        if stop_on_violation and res.violations:
+            res.seconds = time.time() - t0
+            return res
+
+        while any(frontier) and depth < max_depth and \
+                res.distinct_states < max_states:
+            depth += 1
+            SEGB = self.LB             # per-device segment rows
+            t1 = time.time()
+            level_new = 0
+            level_gen = 0
+            next_frontier: List[List] = [[] for _ in range(D)]
+
+            def settle(blks):
+                nonlocal level_new, n_vis
+                for d in range(D):
+                    if blks[d] is not None:
+                        n_vis[d] += blks[d]["n"]
+                        level_new += blks[d]["n"]
+                outs = harvest_blocks(blks)
+                for d in range(D):
+                    if outs[d] is not None:
+                        next_frontier[d].append(outs[d])
+
+            for seg in self._resegment_dev(frontier, SEGB):
+                carry = self._sgrow_table_if_needed(carry, n_vis)
+                carry = self._upload_seg(carry, seg)
+                while True:
+                    carry, summ = self._sseg_jit(carry, self.FAM_CAPS)
+                    s = np.asarray(summ)        # [D, Z_LEN + n_fams]
+                    level_gen += int(s[:, Z_NGEN].sum())
+                    carry["n_gen"] = jnp.zeros((D,), jnp.int32)
+                    if not (s[:, Z_OVF].any() or s[:, Z_FOVF].any()
+                            or s[:, Z_SOVF].any()
+                            or s[:, Z_HOVF].any()):
+                        break
+                    carry = self._handle_mesh_trip(carry, s, n_vis,
+                                                   settle, verbose)
+            # level end: spill the remainder everywhere
+            nl = np.asarray(carry["n_lvl"])
+            carry, blks = self._fetch_shards(carry, nl)
+            settle(blks)
+            res.generated_states += level_gen
+            if level_new == 0 and level_gen == 0:
+                depth -= 1
+            else:
+                res.level_sizes.append(sum(
+                    int(g.shape[0]) for q in next_frontier
+                    for _r, g in q))
+            frontier = next_frontier
+            if stop_on_violation and res.violations:
+                break
+            if verbose:
+                print(f"depth {depth}: +{level_new} states "
+                      f"(total {res.distinct_states}), frontier "
+                      f"{sum(int(g.shape[0]) for q in frontier for _r, g in q)}, "
+                      f"{time.time() - t1:.2f}s", flush=True)
+        res.depth = depth
+        res.seconds = time.time() - t0
+        return res
+
+    # -- trip handling ------------------------------------------------
+
+    def _sgrow_table_if_needed(self, carry, n_vis):
+        need = int(n_vis.max()) + self.LB
+        if need > self._LOAD_MAX * self.VB:
+            while need > self._LOAD_MAX * self.VB:
+                self.VB *= 4
+            carry = self._rehash_sharded(carry)
+        return carry
+
+    def _handle_mesh_trip(self, carry, s, n_vis, settle, verbose):
+        """Spill every shard's committed rows (the tripped step itself
+        committed nowhere — step-atomic), grow whatever tripped, and
+        point every device back at the tripped chunk."""
+        tb = int(s[:, Z_TRIP].max())
+        assert tb >= 0, "trip flags set but no trip_base"
+        nl = s[:, Z_NLVL].astype(np.int64)
+        if s[:, Z_OVF].any():
+            self.mid_level_spills += 1
+        carry, blks = self._fetch_shards(carry, nl)
+        settle(blks)
+        if s[:, Z_FOVF].any():
+            famx = s[:, Z_LEN:Z_LEN + len(self.FAM_CAPS)].max(axis=0)
+            caps = list(self.FAM_CAPS)
+            fam_over = False
+            for fi, fam in enumerate(self.expander.families):
+                hard = fam.n_lanes * self.BL
+                while caps[fi] < hard and famx[fi] > caps[fi]:
+                    caps[fi] = min(2 * caps[fi], hard)
+                    fam_over = True
+            self.FAM_CAPS = tuple(caps)
+            if not fam_over:
+                self.FC *= 4
+        if s[:, Z_SOVF].any():
+            self.SC = 4 * self.SC
+        # only the HARD bound forces shard growth (the level shard must
+        # hold a receive window on top of usable rows).  The classic
+        # engine's 4*FC anti-thrash floor is deliberately NOT applied:
+        # an ovf trip here costs one spill + program re-entry, and
+        # running the shard near-full IS this engine's operating mode.
+        if self.LB < 2 * self.D * self.SC:
+            self.LB = self._round_lb(2 * self.D * self.SC)
+        # grow when any capacity outran the carry's current shapes
+        old_shapes = (carry["fmask"].shape[1], carry["cidx"].shape[1],
+                      carry["sscr"].shape[1])
+        if (self.LB, self.FC, self.SC) != old_shapes:
+            carry = self._grow_sharded(carry)
+        if s[:, Z_HOVF].any():
+            self.VB *= 4
+            carry = self._rehash_sharded(carry)
+        carry = self._sgrow_table_if_needed(carry, n_vis)
+        if verbose:
+            print(f"mesh trip at base {tb}: ovf={s[:, Z_OVF].any()} "
+                  f"fovf={s[:, Z_FOVF].any()} sovf={s[:, Z_SOVF].any()} "
+                  f"hovf={s[:, Z_HOVF].any()} -> LB={self.LB} "
+                  f"FC={self.FC} SC={self.SC} VB={self.VB}",
+                  flush=True)
+        D = self.D
+        carry["ovf"] = jnp.zeros((D,), bool)
+        carry["fovf"] = jnp.zeros((D,), bool)
+        carry["sovf"] = jnp.zeros((D,), bool)
+        carry["hovf"] = jnp.zeros((D,), bool)
+        carry["famx"] = jnp.zeros((D, len(self.expander.families)),
+                                  jnp.int32)
+        carry["trip_base"] = jnp.full((D,), -1, jnp.int32)
+        carry["base"] = jnp.full((D,), tb, jnp.int32)
+        return carry

@@ -1,0 +1,126 @@
+"""Differential tests for the spill-composed sharded engine
+(parallel/spill_mesh): per-device level shards stream through host RAM
+while dedup stays hash-partitioned over all_to_all — the mesh scale
+story and the host-spill depth story in one engine (VERDICT r4 #5).
+
+Shard capacities are squeezed far below the level sizes so every run
+here exercises mid-level spills and step-atomic trip recovery; counts
+must still match the oracle exactly (the micro configs use VIEW-only
+constraint sets, where the surviving representative's non-VIEW content
+cannot affect reachability — spill_mesh module docstring)."""
+
+from collections import Counter
+
+import jax
+import pytest
+
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+from raft_tla_tpu.models.explore import explore
+from raft_tla_tpu.parallel.spill_mesh import SpilledShardedEngine
+
+VIEW_CONSTRAINTS = ("BoundedInFlightMessages", "BoundedRequestVote",
+                    "BoundedLogSize", "BoundedTerms")
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    max_inflight_override=2, next_family=NEXT_ASYNC, symmetry=False,
+    constraints=VIEW_CONSTRAINTS,
+    invariants=("ElectionSafety", "LogMatching"),
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+
+def test_spilled_sharded_micro_exhaustive():
+    """Exhaustive micro parity: counts, level sizes and violations
+    equal the oracle through the composed engine (spill plumbing end
+    to end; the capacity/mid-level-spill claim is pinned by
+    test_spilled_sharded_beyond_shard_capacity below on a space big
+    enough to overflow shards)."""
+    want = explore(MICRO)
+    eng = SpilledShardedEngine(MICRO, devices=jax.devices()[:2],
+                               chunk=16, lcap=128, scap=8,
+                               vcap=1 << 13)
+    got = eng.check()
+    assert got.distinct_states == want.distinct_states, \
+        (got.distinct_states, want.distinct_states)
+    assert got.depth == want.depth
+    assert got.generated_states == want.generated_states
+    assert got.level_sizes == want.level_sizes
+    want_viol = Counter(v.invariant for v in want.violations)
+    got_viol = Counter(v.invariant for v in got.violations)
+    assert got_viol == want_viol
+
+
+def test_spilled_sharded_beyond_shard_capacity():
+    """The done-criterion run (VERDICT r4 #5): an 8-device mesh on the
+    reference cfg whose level rows exceed the mesh's usable shard
+    capacity — levels stream through host RAM in multiple mid-level
+    spill epochs (ovf trips), counts equal the oracle.  Constraints
+    are restricted to the VIEW-only set so the epoch-min survivor
+    policy provably cannot affect reachability (spill_mesh module
+    docstring)."""
+    from raft_tla_tpu.cfg.parser import load_model
+    cfg = load_model("/root/reference/tlc_membership/raft.cfg",
+                     bounds=Bounds.make(max_log_length=1,
+                                        max_timeouts=1,
+                                        max_client_requests=1))
+    cfg = cfg.with_(constraints=VIEW_CONSTRAINTS, invariants=())
+    want = explore(cfg, max_depth=14)
+    eng = SpilledShardedEngine(cfg, chunk=64, lcap=8 * 512, scap=16,
+                               fcap=512, vcap=1 << 15)
+    got = eng.check(max_depth=14)
+    assert got.distinct_states == want.distinct_states, \
+        (got.distinct_states, want.distinct_states)
+    assert got.generated_states == want.generated_states
+    assert got.level_sizes == want.level_sizes
+    # the run genuinely could not fit device-resident: the widest
+    # level exceeds the mesh's TOTAL shard capacity, and the ovf-trip
+    # mid-level spill path fired repeatedly
+    assert max(want.level_sizes) > eng.D * eng.LB, \
+        (max(want.level_sizes), eng.D, eng.LB)
+    assert eng.mid_level_spills > 2, eng.mid_level_spills
+
+
+def test_spilled_sharded_symmetric():
+    want = explore(MICRO.with_(symmetry=True))
+    eng = SpilledShardedEngine(MICRO.with_(symmetry=True), chunk=64,
+                               lcap=8 * 192, vcap=1 << 13)
+    got = eng.check()
+    assert got.distinct_states == want.distinct_states
+    assert got.depth == want.depth
+    assert got.generated_states == want.generated_states
+
+
+def test_spilled_sharded_matches_device_resident():
+    """Same model, same mesh: the composed engine's counts equal the
+    classic device-resident ShardedEngine's (which in turn equal the
+    oracle's) — the composition changes WHERE levels live, not what is
+    reachable."""
+    from raft_tla_tpu.parallel.mesh import ShardedEngine
+    classic = ShardedEngine(MICRO, chunk=64,
+                            store_states=False).check(max_depth=14)
+    eng = SpilledShardedEngine(MICRO, chunk=64, lcap=8 * 192,
+                               vcap=1 << 13)
+    got = eng.check(max_depth=14)
+    assert got.distinct_states == classic.distinct_states
+    assert got.generated_states == classic.generated_states
+    assert got.level_sizes == classic.level_sizes
+
+
+def test_spilled_sharded_mesh_size_invariance():
+    """D=4 vs D=8, different chunk packings and spill timings: counts
+    agree (VIEW-only constraints — representative-choice independent)."""
+    runs = {}
+    for d in (4, 8):
+        eng = SpilledShardedEngine(MICRO, devices=jax.devices()[:d],
+                                   chunk=16 * d, lcap=d * 192,
+                                   vcap=1 << 13)
+        runs[d] = eng.check(max_depth=14)
+    assert runs[4].distinct_states == runs[8].distinct_states
+    assert runs[4].generated_states == runs[8].generated_states
+    assert runs[4].level_sizes == runs[8].level_sizes
+
+
+def test_spilled_sharded_store_states_rejected():
+    with pytest.raises(NotImplementedError, match="archive"):
+        SpilledShardedEngine(MICRO, chunk=64, store_states=True)

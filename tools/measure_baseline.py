@@ -89,30 +89,27 @@ ENGINE_KW = {
 }
 
 
-def measure(n):
+from statistics import median as _median
+
+
+def measure(n, reps=3):
+    """Interleaved A/B protocol (VERDICT r4 #7): the recorded ratio is
+    median(native)/median(engine) over `reps` alternating same-process
+    runs (native, TPU, native, TPU, ...) — the shared single-vCPU host
+    measured the SAME native binary at 24k-150k/s across different
+    days, so single runs hours apart are not comparable."""
     from raft_tla_tpu import native
     from raft_tla_tpu.engine.bfs import Engine
     cfg = build_cfg(n)
     budget = BUDGET[n]
     depth = DEPTH.get(n, 10**9)
-    out = {"config": n, "budget": budget, "max_depth": depth}
+    out = {"config": n, "budget": budget, "max_depth": depth,
+           "protocol": f"interleaved median-of-{reps} (same process)"}
 
     # config 5's target is a scenario property (negated reachability);
     # the native runtime checks safety invariants only, so its rate is
     # measured on the bare state space there
     nat_cfg = cfg.with_(invariants=()) if n == 5 else cfg
-    t0 = time.time()
-    nat = native.check(nat_cfg, threads=os.cpu_count() or 1,
-                       max_states=budget, max_depth=depth)
-    out["native"] = {
-        "distinct": int(nat.distinct_states), "depth": int(nat.depth),
-        "seconds": round(nat.seconds, 2),
-        "states_per_sec": round(nat.states_per_sec, 1),
-        "violations": len(nat.violations),
-        "exhausted": bool(nat.distinct_states < budget),
-    }
-    print(f"config {n} native: {out['native']}", flush=True)
-
     kw = dict(ENGINE_KW[n])
     fam_caps = kw.pop("fam_caps", None)
     eng = Engine(cfg, store_states=False, **kw)
@@ -121,13 +118,41 @@ def measure(n):
     t0 = time.time()
     eng.check(max_depth=min(2, depth))          # warm the jit caches
     compile_s = time.time() - t0
-    t0 = time.time()
-    r = eng.check(max_states=budget, max_depth=depth)
-    secs = time.time() - t0
+
+    nat_rates, eng_rates = [], []
+    nat = r = None
+    for rep in range(max(1, int(reps))):
+        nat = native.check(nat_cfg, threads=os.cpu_count() or 1,
+                           max_states=budget, max_depth=depth)
+        nat_rates.append(round(nat.states_per_sec, 1))
+        t0 = time.time()
+        r = eng.check(max_states=budget, max_depth=depth)
+        secs = time.time() - t0
+        eng_rates.append(round(r.distinct_states / max(secs, 1e-9), 1))
+        print(f"config {n} rep {rep}: native {nat_rates[-1]}/s  "
+              f"engine {eng_rates[-1]}/s", flush=True)
+        # identical counts EVERY rep, not just the last
+        assert (r.distinct_states == nat.distinct_states
+                or n == 5), (r.distinct_states, nat.distinct_states)
+
+    # both `seconds` fields are MEDIAN-DERIVED (distinct/median rate)
+    # so they stay comparable to each other and to states_per_sec; the
+    # raw per-rep rates ride in rates[]
+    out["native"] = {
+        "distinct": int(nat.distinct_states), "depth": int(nat.depth),
+        "seconds": round(nat.distinct_states /
+                         max(_median(nat_rates), 1e-9), 2),
+        "states_per_sec": _median(nat_rates),
+        "rates": nat_rates,
+        "violations": len(nat.violations),
+        "exhausted": bool(nat.distinct_states < budget),
+    }
     out["engine"] = {
         "distinct": int(r.distinct_states), "depth": int(r.depth),
-        "seconds": round(secs, 2),
-        "states_per_sec": round(r.distinct_states / max(secs, 1e-9), 1),
+        "seconds": round(r.distinct_states / max(_median(eng_rates),
+                                                 1e-9), 2),
+        "states_per_sec": _median(eng_rates),
+        "rates": eng_rates,
         "compile_seconds": round(compile_s, 1),
         "violations": len(r.violations),
         "overflow_faults": int(r.overflow_faults),
@@ -161,9 +186,14 @@ def measure(n):
 
 if __name__ == "__main__":
     args = sys.argv[1:]
+    reps = 3
+    if "--reps" in args:
+        i = args.index("--reps")
+        reps = int(args[i + 1])
+        del args[i:i + 2]
     if len(args) == 1:
         try:
-            measure(int(args[0]))
+            measure(int(args[0]), reps=reps)
         except Exception as e:
             print(f"config {args[0]} FAILED: {type(e).__name__}: {e}",
                   flush=True)
@@ -175,4 +205,4 @@ if __name__ == "__main__":
         import subprocess
         for n in [int(a) for a in args] or [1, 2, 3, 4, 5]:
             subprocess.run([sys.executable, os.path.abspath(__file__),
-                            str(n)])
+                            str(n), "--reps", str(reps)])
