@@ -325,7 +325,8 @@ class Engine:
                  lcap: int = 1 << 14, vcap: int = 1 << 17,
                  fcap: Optional[int] = None,
                  ocap: Optional[int] = None,
-                 incremental_fp: bool = True):
+                 incremental_fp: bool = True,
+                 burst: bool = True):
         enable_persistent_compilation_cache()
         self.cfg = cfg
         self.chunk = max(16, int(chunk))
@@ -392,6 +393,12 @@ class Engine:
                                  static_argnums=1)
         self._fin_jit = jax.jit(self._finalize_impl, donate_argnums=0)
         self._rootfp_jit = jax.jit(self.fpr.fingerprint_batch)
+        # small-level burst (see _burst_impl): on by default; burst=False
+        # restores the pure per-level driver (the A/B is pinned by
+        # tests/test_burst.py)
+        self.burst = burst
+        self._burst_jit = jax.jit(self._burst_impl, donate_argnums=0,
+                                  static_argnums=1)
 
     def _round_cap(self, n: int) -> int:
         c = self.chunk
@@ -632,7 +639,8 @@ class Engine:
         action family's touched positions — bit-identical to the
         direct path (tests/test_codec.py) at a fraction of the work on
         wide-expansion configs."""
-        B, A = self.chunk, self.A
+        B, A = valid.shape[0], self.A      # B from the caller's batch:
+        # the level burst expands a whole (small) frontier as one chunk
         N = B * A
         derb = self.expander.derived_batch_T(sv)
         ok = lax.optimization_barrier(self.expander.guards_T(sv, derb))
@@ -890,6 +898,178 @@ class Engine:
         return new_carry, dict(inv_ok=inv_ok, scal=scal)
 
     # ------------------------------------------------------------------
+    # small-level burst: run up to _BURST_LEVELS whole BFS levels in ONE
+    # device call while the frontier fits a single chunk.
+    #
+    # Motivation (measured, round 5): the tunneled-TPU runtime costs
+    # ~172 ms per synchronous dispatch+readback, so a tiny level (one
+    # chunk step + finalize + scalar sync) costs ~220 ms of which the
+    # device computes ~80 ms — the 12 sub-chunk levels every config #3
+    # run pays before the space widens were ~2.6 s of almost pure
+    # latency.  The burst folds those levels into one jit: a
+    # lax.while_loop whose body is the SAME pipeline as a chunk step
+    # (guard-first expand + fingerprint + claim-insert dedup + phase2)
+    # plus the finalize's commit, at chunk width, committing one level
+    # per iteration.  The host reads back ONE stats array for the
+    # whole burst.
+    #
+    # The while carry holds only chunk-width buffers + the visited
+    # table; the big LCAP buffers pass through OUTSIDE the loop (the
+    # reverted whole-level while_loop driver died on XLA padding the
+    # loop-carried [.., S, S, LCAP] buffers — see the note above
+    # _finalize_impl; the burst's loop-carried state is ~1000x smaller).
+    #
+    # Overflow discipline: any overflow (enabled > FCAP, a family cap,
+    # fresh > chunk, probe budget) BAILS: the level's table inserts are
+    # rolled back on the spot (one chunk per level makes the chunk-
+    # local revert exactly level-local), the pre-level frontier is
+    # kept, and the host replays that level through the ordinary
+    # per-level path.  Archives (parents/lanes/state rows/inv bits) are
+    # recorded per level on device and fetched only when needed
+    # (store_states or a violation), so a clean burst costs one small
+    # D2H transfer.
+    # ------------------------------------------------------------------
+
+    _BURST_LEVELS = 16
+    _BS_N = 8                   # stats columns (see _burst_impl)
+
+    def _burst_impl(self, carry, fam_caps, levels_left, states_cap):
+        """Returns (carry', out).  out["stats"] is int32
+        [_BURST_LEVELS + 1, _BS_N]: per-level rows
+        [n_lvl, n_viol, faults, n_expand, n_gen, 0, 0, 0] and a meta
+        row at index _BURST_LEVELS:
+        [n_levels_done, bail, n_front_out, viol_any, states_done].
+        out["par"]/out["lane"] are [L_MAX, B] int32, out["st"] the
+        narrow state rows [..., L_MAX, B], out["inv"] bool
+        [n_inv, L_MAX, B] — the per-level archives."""
+        B, A, W = self.chunk, self.A, self.W
+        FCAP = carry["cidx"].shape[0]
+        VCAP = carry["vis"][0].shape[0]
+        L_MAX = self._BURST_LEVELS
+        n_inv = len(self.inv_names)
+
+        front0 = {k: lax.dynamic_slice_in_dim(v, 0, B, axis=v.ndim - 1)
+                  for k, v in carry["front"].items()}
+        st = dict(
+            vis=carry["vis"], claims=carry["claims"],
+            fr=front0, fm=carry["fmask"][:B], nf=carry["n_front"],
+            li=jnp.int32(0), done=jnp.int32(0),
+            g=carry["g_off"], pg=carry["pg_off"],
+            bail=jnp.bool_(False), viol=jnp.bool_(False),
+            stats=jnp.zeros((L_MAX, self._BS_N), jnp.int32),
+            opar=jnp.full((L_MAX, B), -1, jnp.int32),
+            olane=jnp.full((L_MAX, B), -1, jnp.int32),
+            ost={k: jnp.zeros(v.shape[:-1] + (L_MAX, B), v.dtype)
+                 for k, v in front0.items()},
+            oinv=jnp.ones((n_inv, L_MAX, B), bool),
+        )
+
+        def cond(st):
+            return (~st["bail"] & ~st["viol"] & (st["li"] < levels_left)
+                    & (st["nf"] > 0) & (st["done"] < states_cap))
+
+        def body(st):
+            sv = widen(st["fr"])
+            valid = (jnp.arange(B, dtype=jnp.int32) < st["nf"]) & st["fm"]
+            cand_c, elive, fp, take, famx_c, n_e = self._expand_fp_chunk(
+                sv, valid, fam_caps, FCAP)
+            bail = (n_e > FCAP) | jnp.any(
+                famx_c > jnp.asarray(fam_caps, jnp.int32))
+            keys = tuple(jnp.where(elive, fp[w], U32MAX)
+                         for w in range(W))
+            ranks = jnp.arange(FCAP, dtype=jnp.uint32)
+            vis, claims, fresh, pos, hv = self._probe_insert(
+                st["vis"], st["claims"], keys, elive & ~bail, ranks)
+            bail = bail | hv
+            n_fresh = fresh.sum(dtype=jnp.int32)
+            n_genl = elive.sum(dtype=jnp.int32)
+            bail = bail | (n_fresh > B)
+            # bail => this level never happened: clear its inserts (the
+            # one-chunk level makes the chunk-local revert level-exact)
+            ridx = jnp.where(fresh & bail, pos, VCAP)
+            vis = tuple(vis[w].at[ridx].set(U32MAX, mode="drop")
+                        for w in range(W))
+            fresh = fresh & ~bail
+            commit = ~bail
+
+            # compact fresh candidates -> chunk-wide level rows (same
+            # enumeration order as the per-level path: candidate-slot
+            # ascending = parent-major, lane ascending)
+            lpos = jnp.where(fresh,
+                             jnp.cumsum(fresh.astype(jnp.int32)) - 1, B)
+            lidx = jnp.zeros((B,), jnp.int32).at[lpos].set(
+                jnp.arange(FCAP, dtype=jnp.int32), mode="drop")
+            rows = {k: cand_c[k][..., lidx] for k in cand_c}
+            valid2 = jnp.arange(B, dtype=jnp.int32) < n_fresh
+            inv, con = self._phase2_T(rows)
+            inv_ok = (inv | ~valid2[None, :]) if n_inv \
+                else jnp.ones((0, B), bool)
+            n_viol = (~inv_ok).sum(dtype=jnp.int32)
+            faults = ((rows["ctr"][C_OVERFLOW] > 0) &
+                      valid2).sum(dtype=jnp.int32)
+            n_expand = (con & valid2).sum(dtype=jnp.int32)
+            lane_ids = take[lidx]
+            par_gid = jnp.where(valid2, st["pg"] + lane_ids // A, -1)
+            lane = jnp.where(valid2, lane_ids % A, -1)
+            rows_n = narrow(self.lay, rows)
+
+            li = st["li"]
+            row = jnp.where(commit, jnp.stack(
+                [n_fresh, n_viol, faults, n_expand, n_genl,
+                 jnp.int32(0), jnp.int32(0), jnp.int32(0)]),
+                jnp.zeros((self._BS_N,), jnp.int32))
+            new = dict(st)
+            new["vis"], new["claims"] = vis, claims
+            new["stats"] = lax.dynamic_update_slice(
+                st["stats"], row[None], (li, 0))
+            new["opar"] = lax.dynamic_update_slice(
+                st["opar"], par_gid[None], (li, 0))
+            new["olane"] = lax.dynamic_update_slice(
+                st["olane"], lane[None], (li, 0))
+            new["ost"] = {
+                k: lax.dynamic_update_slice(
+                    v, rows_n[k][..., None, :],
+                    (0,) * (v.ndim - 2) + (li, 0))
+                for k, v in st["ost"].items()}
+            if n_inv:
+                new["oinv"] = lax.dynamic_update_slice(
+                    st["oinv"], inv_ok[:, None, :], (0, li, 0))
+            # frontier advance only on commit (bail keeps the pre-level
+            # frontier so the host can replay the level exactly)
+            new["fr"] = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(commit, a, b), rows_n, st["fr"])
+            new["fm"] = jnp.where(commit, con & valid2, st["fm"])
+            new["nf"] = jnp.where(commit, n_fresh, st["nf"])
+            new["pg"] = jnp.where(commit, st["g"], st["pg"])
+            new["g"] = st["g"] + jnp.where(commit, n_fresh, 0)
+            new["done"] = st["done"] + jnp.where(commit, n_fresh, 0)
+            new["li"] = li + commit.astype(jnp.int32)
+            new["bail"] = bail
+            new["viol"] = st["viol"] | (commit & (n_viol > 0))
+            return new
+
+        st = lax.while_loop(cond, body, st)
+
+        meta = jnp.zeros((self._BS_N,), jnp.int32)
+        meta = meta.at[0].set(st["li"])
+        meta = meta.at[1].set(st["bail"].astype(jnp.int32))
+        meta = meta.at[2].set(st["nf"])
+        meta = meta.at[3].set(st["viol"].astype(jnp.int32))
+        meta = meta.at[4].set(st["done"])
+        stats = jnp.concatenate([st["stats"], meta[None]], axis=0)
+
+        fmask = jnp.zeros_like(carry["fmask"]).at[:B].set(st["fm"])
+        front = {k: lax.dynamic_update_slice_in_dim(
+                     v, st["fr"][k], 0, axis=v.ndim - 1)
+                 for k, v in carry["front"].items()}
+        new_carry = dict(carry, vis=st["vis"], claims=st["claims"],
+                         front=front, fmask=fmask, n_front=st["nf"],
+                         g_off=st["g"], pg_off=st["pg"])
+        return new_carry, dict(stats=stats, par=st["opar"],
+                               lane=st["olane"], st=st["ost"],
+                               inv=st["oinv"])
+
+    # ------------------------------------------------------------------
 
     def _fresh_carry(self, lcap: int, vcap: int, fcap: Optional[int] = None,
                      ocap: Optional[int] = None):
@@ -1062,11 +1242,11 @@ class Engine:
             # the ONE per-level device->host sync
             return carry, out, [int(x) for x in np.asarray(out["scal"])]
 
-        def grow_table_if_needed(carry):
+        def grow_table_if_needed(carry, min_add=0):
             # pessimistic load bound: a level can add at most
-            # LCAP - OCAP keys, so checking before the level needs no
-            # mid-level sync
-            need = n_vis + self.LCAP - self.OCAP
+            # LCAP - OCAP keys (a burst up to min_add), so checking
+            # before the level needs no mid-level sync
+            need = n_vis + max(self.LCAP - self.OCAP, min_add)
             if need > self._LOAD_MAX * self.VCAP:
                 while need > self._LOAD_MAX * self.VCAP:
                     self.VCAP *= 4
@@ -1124,6 +1304,86 @@ class Engine:
 
         while n_front and depth < max_depth and \
                 res.distinct_states < max_states:
+            if self.burst and n_front <= self.chunk:
+                # small-level burst: run up to _BURST_LEVELS levels in
+                # one device call (see _burst_impl).  nlev == 0 means
+                # the very first level bailed on an overflow — fall
+                # through and let the per-level path (with its growth
+                # machinery) run that level.
+                t1 = time.time()
+                carry = grow_table_if_needed(
+                    carry, min_add=self._BURST_LEVELS * self.chunk)
+                lv_left = min(self._BURST_LEVELS, max_depth - depth)
+                st_cap = max(1, min(max_states - res.distinct_states,
+                                    2 ** 31 - 1))
+                carry, bout = self._burst_jit(
+                    carry, self.FAM_CAPS, jnp.int32(lv_left),
+                    jnp.int32(st_cap))
+                stats = np.asarray(bout["stats"])  # the ONE burst sync
+                nlev = int(stats[-1, 0])
+                if nlev:
+                    n_front = int(stats[-1, 2])
+                    viol_any = bool(stats[-1, 3])
+                    par_h = lane_h = st_h = inv_h = None
+                    if self.store_states or viol_any:
+                        par_h = np.asarray(bout["par"])
+                        lane_h = np.asarray(bout["lane"])
+                        st_h = {k: np.asarray(v)
+                                for k, v in bout["st"].items()}
+                        inv_h = np.asarray(bout["inv"])
+                    for li in range(nlev):
+                        n_lvl, n_viol, faults, n_expand, n_genl = (
+                            int(x) for x in stats[li, :5])
+                        res.distinct_states += n_lvl
+                        res.generated_states += n_genl
+                        res.overflow_faults += faults
+                        res.violations_global += n_viol
+                        if self.store_states:
+                            self._parents.append(
+                                par_h[li, :n_lvl].copy())
+                            self._lanes.append(
+                                lane_h[li, :n_lvl].copy())
+                            self._states.append(
+                                {k: np.moveaxis(
+                                    v[..., li, :n_lvl], -1, 0).copy()
+                                 for k, v in st_h.items()})
+                        if n_viol:
+                            rows = {k: np.moveaxis(
+                                        v[..., li, :n_lvl], -1, 0)
+                                    for k, v in st_h.items()}
+                            for j, nm in enumerate(self.inv_names):
+                                for s in np.nonzero(
+                                        ~inv_h[j, li, :n_lvl])[0]:
+                                    vsv, vh = decode(self.lay,
+                                                     _take(rows, s))
+                                    res.violations.append(Violation(
+                                        nm, n_states + int(s),
+                                        state=vsv, hist=vh))
+                        if n_lvl == 0 and n_genl == 0:
+                            pass     # all-pruned frontier: not a level
+                        else:
+                            depth += 1
+                            res.level_sizes.append(n_expand)
+                        n_states += n_lvl
+                        n_vis += n_lvl
+                    if n_states >= 2 ** 31 - 1:
+                        raise RuntimeError(
+                            "state-id space exhausted (2^31 ids): run "
+                            "exceeds the engine's int32 global-id width")
+                    t_dev += time.time() - t1
+                    if checkpoint_path is not None and \
+                            depth % max(1, checkpoint_every) == 0:
+                        self._save_checkpoint(checkpoint_path, carry,
+                                              res, depth, n_states,
+                                              n_vis, n_front)
+                    if stop_on_violation and res.violations:
+                        break
+                    if verbose:
+                        print(f"burst: {nlev} levels to depth {depth} "
+                              f"(total {res.distinct_states}), "
+                              f"frontier {n_front}, "
+                              f"{time.time() - t1:.2f}s")
+                    continue
             depth += 1
             t1 = time.time()
             carry = grow_table_if_needed(carry)

@@ -80,7 +80,10 @@ ENGINE_KW = {
     # under-size (mid-run growth = ~100s replay+recompile) and
     # over-size (every phase pays the buffer width) — measured
     # 18.2k -> 31.2k states/s round-over-round on this config
-    3: dict(chunk=2048, lcap=1 << 21, vcap=1 << 24, fcap=45056,
+    # lcap=2^23 pre-sizes for depth 17's 2.14M-state level: at 2^21 the
+    # first rep pays a grow+recompile+replay (~200s) that the median
+    # then hides — measured 12.3k/s rep-1 vs 85.6k/s steady-state
+    3: dict(chunk=2048, lcap=1 << 23, vcap=1 << 24, fcap=45056,
             ocap=1 << 14,
             fam_caps=(3584, 512, 3584, 2048, 3072, 2560, 1024, 8192,
                       4608, 8192, 7680, 7680, 2048, 3072)),
