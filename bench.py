@@ -78,6 +78,71 @@ def perf_floor(rate, max_depth, plat, floor_path, gate_ok=True,
     return info, status == "hard"
 
 
+def _no_reference_fallback():
+    """Containers without the reference checkout (and without the TPU)
+    cannot run the headline metric at all — emit ONE honestly-labeled
+    JSON line instead of a traceback, carrying the only measurement
+    that IS possible here: a correctness-gated micro A/B of the spill
+    engine with the host-partitioned table OFF vs ON (ISSUE 1: the
+    floor must be shown still-ok both ways; on this platform the floor
+    row skips by platform_prefix, and the host table defaults OFF so
+    the floor-guarded paths are untouched)."""
+    import jax
+
+    from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+    from raft_tla_tpu.engine.spill import SpillEngine
+    from raft_tla_tpu.models.explore import explore
+
+    micro = ModelConfig(
+        n_servers=2, init_servers=(0, 1), values=(1,),
+        next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+        bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                           max_client_requests=1))
+    want = explore(micro)
+    plat = str(jax.devices()[0].device_kind)
+    floor_path = os.path.join(os.path.dirname(os.path.abspath(
+        __file__)), "BENCH_FLOOR.json")
+    ab = {}
+    gate_ok = True
+    for label, kw in (("host_table_off", {}),
+                      ("host_table_on", dict(host_table=True,
+                                             partitions=4,
+                                             part_cap=1 << 10))):
+        eng = SpillEngine(micro, chunk=64, store_states=False,
+                          seg=1 << 10, vcap=1 << 12, sync_every=2, **kw)
+        eng.check(max_depth=2)                   # warm the jit caches
+        t0 = time.time()
+        r = eng.check()
+        secs = time.time() - t0
+        ok = (r.distinct_states == want.distinct_states and
+              r.depth == want.depth and
+              r.level_sizes == want.level_sizes)
+        gate_ok = gate_ok and ok
+        # the run's REAL depth, never MAX_DEPTH: a micro rate vs the
+        # config-2 floor would read as a bogus 'hard' regression on
+        # any TPU-prefixed host that merely lacks /root/reference —
+        # the non-headline-depth guard must skip it everywhere
+        floor_info, _zero = perf_floor(
+            r.distinct_states / max(secs, 1e-9), int(r.depth), plat,
+            floor_path, gate_ok=ok, allow_bump=False,
+            key="spill_config2_depth19")
+        ab[label] = {
+            "distinct_states": int(r.distinct_states),
+            "seconds": round(secs, 2),
+            "states_per_sec": round(
+                r.distinct_states / max(secs, 1e-9), 1),
+            "counts_match_oracle": bool(ok),
+            "perf_floor": floor_info}
+    print(json.dumps({
+        "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
+        "value": None, "unit": "states/sec", "vs_baseline": None,
+        "status": "headline skipped: /root/reference cfgs and the TPU "
+                  "are absent on this container; floor rows skip by "
+                  "platform_prefix and BENCH_FLOOR.json is unchanged",
+        "detail": {"platform": plat, "correctness_gate": bool(gate_ok),
+                   "micro_spill_ab": ab}}))
+
+
 def main():
     from raft_tla_tpu import native
     from raft_tla_tpu.cfg.parser import load_model
@@ -86,6 +151,9 @@ def main():
     from raft_tla_tpu.models.explore import explore
 
     # -- correctness gate (micro config, fast) --------------------------
+    if not os.path.exists("/root/reference/tlc_membership/raft.cfg"):
+        _no_reference_fallback()
+        return
     micro = load_model("/root/reference/tlc_membership/raft.cfg",
                        bounds=Bounds.make(max_log_length=1, max_timeouts=1,
                                           max_client_requests=1))

@@ -195,16 +195,29 @@ def cmd_check(args):
             r.generated_states
     else:
         from .engine.bfs import CheckpointError, Engine
+        if args.host_table and not args.spill:
+            print("--host-table composes with the spill engine: add "
+                  "--spill", file=sys.stderr)
+            return 2
         if args.spill:
             # host-spill engine: levels stream through host RAM, for
-            # depths whose level buffers exceed HBM (engine/spill)
+            # depths whose level buffers exceed HBM (engine/spill);
+            # --host-table additionally moves the visited set to
+            # fingerprint-prefix partitions in host RAM, streamed
+            # through HBM per level (engine/host_table) — the ceiling
+            # becomes host RAM, not the chip
             from .engine.spill import SpillEngine
             eng = SpillEngine(cfg, chunk=args.chunk,
                               store_states=not args.no_store,
-                              seg=args.seg)
+                              seg=args.seg,
+                              host_table=args.host_table,
+                              partitions=args.partitions,
+                              part_cap=args.part_cap,
+                              archive_dir=args.archive_dir)
         else:
             eng = Engine(cfg, chunk=args.chunk,
-                         store_states=not args.no_store)
+                         store_states=not args.no_store,
+                         archive_dir=args.archive_dir)
         try:
             r = eng.check(max_depth=args.max_depth,
                           max_states=args.max_states,
@@ -392,6 +405,29 @@ def main(argv=None):
                          "required past the single-chip HBM depth wall")
     pc.add_argument("--seg", type=int, default=1 << 21,
                     help="spill segment capacity in states (--spill)")
+    pc.add_argument("--host-table", action="store_true",
+                    help="host-partitioned visited table (needs "
+                         "--spill): the authoritative fingerprint set "
+                         "lives in host RAM as fingerprint-prefix "
+                         "partitions streamed through HBM per level; "
+                         "the device table becomes a bounded cache — "
+                         "breaks the ~2^29-slot HBM dedup ceiling "
+                         "(TLC's disk-spillable fingerprint set "
+                         "counterpart)")
+    pc.add_argument("--partitions", type=int, default=4, metavar="P",
+                    help="host-table partition count, a power of two "
+                         "(counts are P-invariant; P sizes the "
+                         "largest image HBM must hold at once)")
+    pc.add_argument("--part-cap", type=int, default=1 << 16,
+                    metavar="N",
+                    help="initial slots per host-table partition "
+                         "(grows 4x on the 0.40 load bound)")
+    pc.add_argument("--archive-dir", default=None, metavar="DIR",
+                    help="disk-backed trace archives: stream each "
+                         "level's parent/lane/state rows to memmap'd "
+                         "files under DIR instead of growing host "
+                         "arrays (store_states runs stay RAM-bounded; "
+                         "traces replay from the memmaps)")
     pc.add_argument("--no-store", action="store_true",
                     help="do not retain states (no traces; less memory)")
     pc.add_argument("--max-violations", type=int, default=5)

@@ -58,6 +58,7 @@ from ..ops.codec import (C_GLOBLEN, C_OVERFLOW, decode, encode, narrow,
 from ..ops.kernels import RaftKernels
 from ..ops.layout import Layout
 from ..ops.vpredicates import Predicates
+from ..utils import HOME_SALT
 from ..utils import cat_arrays as _cat
 from ..utils import fmix32_int as _fmix32_int
 from ..utils import fp_key
@@ -67,7 +68,9 @@ from .fingerprint import Fingerprinter, fmix32
 
 U32MAX = jnp.uint32(0xFFFFFFFF)
 
-_HOME_SALT = 0x9E3779B9
+# historical name; the canonical definition lives in utils.HOME_SALT
+# (shared with the host-partition images — see utils docstring)
+_HOME_SALT = HOME_SALT
 
 
 class CheckpointError(ValueError):
@@ -326,11 +329,22 @@ class Engine:
                  fcap: Optional[int] = None,
                  ocap: Optional[int] = None,
                  incremental_fp: bool = True,
-                 burst: bool = True):
+                 burst: bool = True,
+                 archive_dir: Optional[str] = None):
         enable_persistent_compilation_cache()
         self.cfg = cfg
         self.chunk = max(16, int(chunk))
         self.store_states = store_states
+        # disk-backed per-level trace archives (engine/archive): with
+        # store_states, parents/lanes/state rows stream to memmap'd
+        # files under this run directory instead of growing host
+        # arrays, so trace reconstruction is RAM-bounded.  None keeps
+        # the historical in-RAM archive.
+        self.archive_dir = archive_dir
+        self._arch = None
+        self._states: List[Dict[str, np.ndarray]] = []
+        self._parents: List[np.ndarray] = []
+        self._lanes: List[np.ndarray] = []
         # incremental per-action fingerprints (auto-off for big
         # symmetry groups — fingerprint.supports_incremental)
         self.incremental_fp = incremental_fp
@@ -1163,6 +1177,65 @@ class Engine:
         return _take(init_arrs, first_idx), root_fp[first_idx], \
             pin_interiors
 
+    # ------------------------------------------------------------------
+    # trace-archive plumbing (engine/archive): every engine family
+    # stores per-level parent/lane/state arrays either in host RAM (the
+    # historical lists) or streamed to memmap'd per-level files under
+    # ``archive_dir`` — one dispatch point so check loops, checkpoints
+    # and trace reconstruction stay backing-agnostic.
+    # ------------------------------------------------------------------
+
+    def _init_store(self):
+        self._states, self._parents, self._lanes = [], [], []
+        self._arch = None
+        if self.store_states and self.archive_dir:
+            from .archive import DiskArchive
+            self._arch = DiskArchive(self.archive_dir)
+
+    def _archive_level(self, parents, lanes, states_major):
+        if self._arch is not None:
+            self._arch.append_level(parents, lanes, states_major)
+        else:
+            self._parents.append(parents)
+            self._lanes.append(lanes)
+            self._states.append(states_major)
+
+    def _ckpt_store_args(self):
+        """(parents, lanes, states, extra-meta) for ckpt_write: a disk
+        archive already persists itself level-by-level, so checkpoints
+        record only its level count instead of re-embedding rows."""
+        if self._arch is not None:
+            return [], [], [], dict(disk_archive=True,
+                                    arch_levels=self._arch.n_levels)
+        return self._parents, self._lanes, self._states, {}
+
+    def _load_archives(self, path, z, meta, template):
+        """Resume-side twin of _ckpt_store_args: reattach the disk
+        archive (truncating levels past the checkpoint, so a resumed
+        run re-appends them bit-identically) or unpack the embedded
+        in-RAM archives."""
+        from .archive import ArchiveError, DiskArchive
+        if meta.get("disk_archive"):
+            if not (self.store_states and self.archive_dir):
+                raise CheckpointError(
+                    f"{path}: checkpoint archives live in a disk "
+                    "archive directory — resume with the same "
+                    "archive_dir (CLI: --archive-dir)")
+            try:
+                self._arch = DiskArchive(self.archive_dir, attach=True)
+                self._arch.truncate(meta["arch_levels"])
+            except ArchiveError as e:
+                raise CheckpointError(str(e)) from e
+            self._parents, self._lanes, self._states = [], [], []
+            return
+        if self.store_states and self.archive_dir:
+            raise CheckpointError(
+                f"{path}: checkpoint holds in-RAM archives; resume "
+                "without archive_dir")
+        self._arch = None
+        self._parents, self._lanes, self._states = ckpt_archives(
+            z, meta, template, self.store_states)
+
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
               seed_states: Optional[List] = None,
@@ -1180,9 +1253,6 @@ class Engine:
         uninterrupted run; levels are never half-resumed)."""
         t0 = time.time()
         lay = self.lay
-        self._states: List[Dict[str, np.ndarray]] = []
-        self._parents: List[np.ndarray] = []
-        self._lanes: List[np.ndarray] = []
 
         if resume_from is not None:
             carry, res, meta = self._load_checkpoint(resume_from)
@@ -1192,6 +1262,7 @@ class Engine:
             n_front = meta["n_front"]
             resumed = True
         else:
+            self._init_store()
             roots, rk, pin_interiors = self._dedup_roots(seed_states)
             n_roots = len(rk)
 
@@ -1270,9 +1341,9 @@ class Engine:
                 # next-next level's chunk steps.  Archives are stored
                 # batch-major numpy (host layout) — decode/trace/_take
                 # row-index them.
-                self._parents.append(np.asarray(carry["lpar"][:n_lvl]))
-                self._lanes.append(np.asarray(carry["llane"][:n_lvl]))
-                self._states.append(
+                self._archive_level(
+                    np.asarray(carry["lpar"][:n_lvl]),
+                    np.asarray(carry["llane"][:n_lvl]),
                     {k: np.moveaxis(np.asarray(v[..., :n_lvl]), -1, 0)
                      for k, v in carry["front"].items()})
             if n_viol:
@@ -1339,11 +1410,9 @@ class Engine:
                         res.overflow_faults += faults
                         res.violations_global += n_viol
                         if self.store_states:
-                            self._parents.append(
-                                par_h[li, :n_lvl].copy())
-                            self._lanes.append(
-                                lane_h[li, :n_lvl].copy())
-                            self._states.append(
+                            self._archive_level(
+                                par_h[li, :n_lvl].copy(),
+                                lane_h[li, :n_lvl].copy(),
                                 {k: np.moveaxis(
                                     v[..., li, :n_lvl], -1, 0).copy()
                                  for k, v in st_h.items()})
@@ -1528,12 +1597,13 @@ class Engine:
 
     def _save_checkpoint(self, path, carry, res, depth, n_states,
                          n_vis, n_front):
-        ckpt_write(path, carry, self.store_states, self._parents,
-                   self._lanes, self._states, res, dict(
+        parents, lanes, states, arch_meta = self._ckpt_store_args()
+        ckpt_write(path, carry, self.store_states, parents,
+                   lanes, states, res, dict(
                        depth=depth, n_states=n_states, n_vis=n_vis,
                        n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
                        FCAP=self.FCAP, OCAP=self.OCAP,
-                       fam_caps=list(self.FAM_CAPS),
+                       fam_caps=list(self.FAM_CAPS), **arch_meta,
                        layout=2, chunk=self.chunk, cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
@@ -1553,8 +1623,7 @@ class Engine:
             lambda: self._fresh_carry(self.LCAP, self.VCAP, self.FCAP,
                                       self.OCAP))
         carry = ckpt_carry(path, z, template, jnp.asarray)
-        self._parents, self._lanes, self._states = ckpt_archives(
-            z, meta, template, self.store_states)
+        self._load_archives(path, z, meta, template)
         res = ckpt_result(z, meta)
         z.close()             # all arrays extracted; don't leak the fd
         return carry, res, meta
@@ -1566,6 +1635,8 @@ class Engine:
 
     def get_state_arrays(self, gid: int) -> Dict[str, np.ndarray]:
         assert self.store_states, "state store disabled"
+        if self._arch is not None:
+            return self._arch.state_row(gid)
         off = 0
         for blk in self._states:
             n = len(blk["ct"])
@@ -1575,6 +1646,17 @@ class Engine:
         raise IndexError(gid)
 
     def trace(self, gid: int) -> List[Tuple[str, State]]:
+        if self._arch is not None:
+            # memmap'd walk: each hop reads one parent/lane pair and
+            # one state row — no level is ever loaded whole
+            chain = []
+            g = gid
+            while g >= 0:
+                par, lane = self._arch.parent_lane(g)
+                label = self.labels[lane] if lane >= 0 else "Init"
+                chain.append((label, self.get_state(g)[0]))
+                g = par
+            return list(reversed(chain))
         parents = np.concatenate(self._parents)
         lanes = np.concatenate(self._lanes)
         chain = []

@@ -65,8 +65,10 @@ from ..config import ModelConfig
 from ..models.raft import init_state
 from ..ops.codec import C_OVERFLOW, decode, encode, narrow, widen
 from .bfs import (CheckResult, CheckpointError, Engine, U32MAX,
-                  Violation, ckpt_archives, ckpt_read, ckpt_result,
+                  _HOME_SALT, Violation, ckpt_read, ckpt_result,
                   ckpt_write)
+from .fingerprint import fmix32
+from .host_table import HostPartitionedTable
 
 # summary vector layout (int32): the per-window device->host sync
 (S_NLVL, S_NGEN, S_OVF, S_FOVF, S_HOVF, S_OOVF, S_TRIP, S_OFX,
@@ -89,41 +91,50 @@ class SpillEngine(Engine):
                  store_states: bool = False, seg: int = 1 << 21,
                  vcap: int = 1 << 22, fcap: Optional[int] = None,
                  ocap: Optional[int] = None, sync_every: int = 8,
-                 host_table: bool = False, table_levels: int = 2,
-                 trace_dir: Optional[str] = None):
+                 host_table: bool = False, partitions: int = 4,
+                 part_cap: int = 1 << 12,
+                 dev_keys: Optional[int] = None,
+                 archive_dir: Optional[str] = None):
         super().__init__(cfg, chunk=chunk, store_states=store_states,
                          lcap=seg, vcap=vcap, fcap=fcap, ocap=ocap,
-                         burst=False)
+                         burst=False, archive_dir=archive_dir)
         self.SEGL = self.LCAP          # level segment rows (can grow)
         self.SEGF = self.LCAP          # frontier segment rows (fixed)
         self.sync_every = max(1, int(sync_every))
-        # host-majority visited set (VERDICT r4 missing #1): the HBM
-        # table holds only the last `table_levels` levels' keys (the
-        # overwhelming share of BFS re-generations point a step or two
-        # back); every spilled block's fingerprints are then checked on
-        # host against the append-only sorted archive of ALL keys.  The
-        # device can only err fresh-ward (an evicted key re-admitted),
-        # never suppress a truly-new state, so the host archive is the
-        # sole authority on distinctness and counts stay EXACT — no
-        # collision class is added beyond the fingerprints themselves.
-        # The exhaustive ceiling moves from "total distinct fits the
-        # HBM table" (~214M keys fp64 on 16 GB) to "a single level's
-        # fresh keys fit it", with the archive bounded by host RAM
-        # (~8 B/key fp64).  TLC's disk-backed fingerprint set is the
-        # reference behavior (/root/reference/.gitignore:4).
+        # host-partitioned visited table (VERDICT r4 missing #1;
+        # engine/host_table module docstring): the authoritative
+        # visited set lives in host RAM as P fingerprint-prefix
+        # partitions, swept through HBM partition-by-partition at level
+        # boundaries; the HBM table degrades to a bounded CACHE of
+        # recent levels' keys.  The cache is complete over the running
+        # level (it grows mid-level if it must), so level keys reach
+        # the sweep already unique and in enumeration order; the cache
+        # can only err fresh-ward (an evicted key re-admitted), never
+        # suppress a truly-new state, so the sweep's membership verdict
+        # keeps counts EXACT — no collision class is added beyond the
+        # fingerprints themselves.  The exhaustive ceiling moves from
+        # "total distinct keys fit the HBM table" (~214M fp64 on
+        # 16 GB) to "one partition image + one level's keys fit it",
+        # bounded by host RAM at 20-80 B/key fp64 (8 B/slot images
+        # between the 0.40 load bound and a fresh 4x growth).
+        # TLC's disk-spillable fingerprint set is the reference
+        # behavior (SURVEY §5).
         self.host_table = bool(host_table)
-        self.table_levels = max(1, int(table_levels))
-        # disk-backed trace archives: with store_states, each level's
-        # state rows stream to trace_dir/level_NNNN/*.npy (parents/
-        # lanes stay in RAM — they are the 8 B/state trace skeleton);
-        # get_state/trace read rows back via mmap, so witness
-        # reconstruction at beyond-the-wall depths never holds a
-        # level's rows in RAM (VERDICT r4 missing #1, archive half).
-        self.trace_dir = trace_dir
+        self.partitions = int(partitions)
+        self.part_cap = int(part_cap)
+        self.VCAP0 = self.VCAP         # reseed resets the cache here
+        # cache budget: past this occupancy at a level boundary the
+        # device table resets and reseeds with the frontier's keys
+        # (the only keys the next level's expansion re-generates at
+        # high rate); everything older answers from the host sweep
+        self.dev_keys = (int(dev_keys) if dev_keys
+                         else int(self._LOAD_MAX * self.VCAP))
+        self.hpt = None                # built per check()/resume
         self._paste_cache = {}         # upload-paste jit per block size
         self._slice_cache = {}         # spill-slice jit per block size
         self._ckpt_sparse_cache = {}   # sparse-table jit per size
         self._seed_cache = {}          # table-reseed jit per size
+        self._member_cache = {}        # sweep-membership jit per shape
         self._sstep_jit = jax.jit(self._spill_step_impl,
                                   donate_argnums=0, static_argnums=1)
 
@@ -319,17 +330,26 @@ class SpillEngine(Engine):
                 # identity view of the live segment buffer, which the
                 # next donated step would delete out from under the
                 # pending async copy
-                def impl(lvl, lpar, llane, linv, lcon, nq=nq):
-                    return dict(
+                def impl(lvl, lpar, llane, linv, lcon, lfp=None,
+                         nq=nq):
+                    out = dict(
                         rows={k: lax.slice_in_dim(v, 0, nq, axis=v.ndim - 1)
                               for k, v in lvl.items()},
                         lpar=lax.slice_in_dim(lpar, 0, nq, axis=0),
                         llane=lax.slice_in_dim(llane, 0, nq, axis=0),
                         linv=lax.slice_in_dim(linv, 0, nq, axis=1),
                         lcon=lax.slice_in_dim(lcon, 0, nq, axis=0))
+                    if lfp is not None:
+                        # the rows' fingerprints ride the spill: they
+                        # feed the host-partition sweep and the cache
+                        # reseed (host-table mode only)
+                        out["lfp"] = lax.slice_in_dim(lfp, 0, nq,
+                                                      axis=1)
+                    return out
                 fn = self._slice_cache[nq] = jax.jit(impl)
             dev = fn(carry["lvl"], carry["lpar"], carry["llane"],
-                     carry["linv"], carry["lcon"])
+                     carry["linv"], carry["lcon"],
+                     carry["lfp"] if self.host_table else None)
             for leaf in jax.tree_util.tree_leaves(dev):
                 try:
                     leaf.copy_to_host_async()
@@ -375,6 +395,8 @@ class SpillEngine(Engine):
         blk["llane"] = trim(dev["llane"], 0)
         blk["linv"] = trim(dev["linv"], 1)
         blk["lcon"] = trim(dev["lcon"], 0)
+        if "lfp" in dev:
+            blk["lfp"] = trim(dev["lfp"], 1)
         return blk
 
     def _stage_segment(self, seg_rows: Dict[str, np.ndarray],
@@ -456,6 +478,144 @@ class SpillEngine(Engine):
                  for k in keys}, np.concatenate(buf_gids))
 
     # ------------------------------------------------------------------
+    # host-partitioned table: the per-level partition sweep and the
+    # device-cache reseed (engine/host_table module docstring)
+    # ------------------------------------------------------------------
+
+    def _member_fn(self, cap: int, nq: int):
+        """Jit'd gathers-only membership probe of nq keys against a
+        cap-slot partition image (one cache entry per shape pair):
+        the device half of the sweep — same home hash and quadratic
+        walk as _probe_insert, no writes."""
+        fn = self._member_cache.get((cap, nq))
+        if fn is None:
+            W = self.W
+            MAXR = self._MAX_PROBE_ROUNDS
+
+            def impl(img, keys, n):
+                live = jnp.arange(nq, dtype=jnp.int32) < n
+                h = jnp.full((nq,), _HOME_SALT, jnp.uint32)
+                for w in range(W):
+                    h = fmix32(h ^ keys[w])
+                pos = (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+
+                def classify(pos):
+                    iskey = jnp.ones((nq,), bool)
+                    isempty = jnp.ones((nq,), bool)
+                    for w in range(W):
+                        cur = img[w, pos]
+                        iskey &= cur == keys[w]
+                        isempty &= cur == U32MAX
+                    return iskey, isempty
+
+                def cond(st):
+                    _p, _t, act, _f, r = st
+                    return act.any() & (r < MAXR)
+
+                def body(st):
+                    pos, t, act, found, r = st
+                    iskey, isempty = classify(pos)
+                    found = found | (act & iskey)
+                    act = act & ~(iskey | isempty)
+                    t = jnp.where(act, t + 1, t)
+                    pos = jnp.where(act, (pos + t) & (cap - 1), pos)
+                    return pos, t, act, found, r + 1
+
+                st = (pos, jnp.zeros((nq,), jnp.int32), live,
+                      jnp.zeros((nq,), bool), jnp.int32(0))
+                _p, _t, act, found, _r = lax.while_loop(cond, body, st)
+                return found, act.any()
+            fn = self._member_cache[(cap, nq)] = jax.jit(impl)
+        return fn
+
+    def _sweep_level_keys(self, keys: np.ndarray) -> np.ndarray:
+        """One level's partition sweep: bucket the level's keys (u32
+        [N, W], unique within the level, enumeration order) by
+        fingerprint prefix, stream each partition's image through the
+        device for the membership probe — partition p+1's H2D staging
+        is issued before p's verdict is forced, so the upload rides the
+        host link while the device probes (the spill engine's
+        double-buffering discipline) — then commit the fresh keys into
+        the host partitions.  Returns keep = not-seen-before [N]."""
+        n_all = keys.shape[0]
+        keep = np.ones(n_all, bool)
+        if n_all == 0:
+            return keep
+        hpt = self.hpt
+        pids = hpt.partition_ids(keys)
+        plan = []
+        for p in range(hpt.P):
+            idx = np.nonzero(pids == p)[0]
+            if idx.size:
+                plan.append((p, idx))
+        staged = {}
+
+        def stage(j):
+            if j < len(plan):
+                p, idx = plan[j]
+                # grow BEFORE the upload so the device image honors the
+                # probe-budget load bound even after this level commits
+                hpt.reserve(p, int(idx.size))
+                staged[j] = jax.device_put(hpt.imgs[p])
+
+        stage(0)
+        pending = []
+        for j, (p, idx) in enumerate(plan):
+            img = staged.pop(j)
+            n = int(idx.size)
+            nq = self._quantize(n, 1 << 30, floor=1 << 8)
+            kq = np.full((self.W, nq), np.uint32(0xFFFFFFFF),
+                         np.uint32)
+            kq[:, :n] = keys[idx].T
+            fn = self._member_fn(int(img.shape[1]), nq)
+            found, hovf = fn(img, jax.device_put(kq), jnp.int32(n))
+            stage(j + 1)        # next partition's H2D rides now
+            pending.append((idx, found, hovf))
+        for idx, found, hovf in pending:
+            if bool(np.asarray(hovf)):
+                raise RuntimeError(
+                    "host-partition sweep probe walk did not converge "
+                    "— partition image pathologically full")
+            keep[idx] = ~np.asarray(found)[:idx.size]
+        hpt.commit(keys, keep)
+        return keep
+
+    def _reseed_dev_table(self, carry, fkeys: np.ndarray):
+        """Reset the device cache to the frontier's keys at (near) the
+        initial capacity: the frontier cohort is what the next level
+        re-generates at high rate; everything older answers from the
+        host sweep.  Only ever called at a level boundary — the cache
+        must stay complete over a running level."""
+        n = int(fkeys.shape[0])
+        self.VCAP = self.VCAP0
+        while n + self.SEGL - self.OCAP > self._LOAD_MAX * self.VCAP:
+            self.VCAP *= 4
+        nq = self._quantize(max(n, 1), 1 << 30, floor=1 << 8)
+        kq = np.full((self.W, nq), np.uint32(0xFFFFFFFF), np.uint32)
+        if n:
+            kq[:, :n] = fkeys.T
+        fn = self._seed_cache.get((self.VCAP, nq))
+        if fn is None:
+            VCAP = self.VCAP
+
+            def impl(keys, n):
+                table = tuple(jnp.full((VCAP,), U32MAX)
+                              for _ in range(self.W))
+                claims = jnp.full((VCAP,), U32MAX)
+                live = jnp.arange(nq, dtype=jnp.int32) < n
+                ks = tuple(keys[w] for w in range(self.W))
+                ranks = jnp.arange(nq, dtype=jnp.uint32)
+                table, claims, _f, _p, hv = self._probe_insert(
+                    table, claims, ks, live, ranks)
+                return table, claims, hv
+            fn = self._seed_cache[(self.VCAP, nq)] = jax.jit(impl)
+        vis, claims, hv = fn(jnp.asarray(kq), jnp.int32(n))
+        if bool(np.asarray(hv)):
+            raise RuntimeError(
+                "cache reseed probe overflow — raise vcap")
+        return dict(carry, vis=vis, claims=claims), n
+
+    # ------------------------------------------------------------------
 
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
@@ -466,15 +626,18 @@ class SpillEngine(Engine):
               verbose: bool = False) -> CheckResult:
         t0 = time.time()
         lay = self.lay
-        self._states: List[Dict[str, np.ndarray]] = []
-        self._parents: List[np.ndarray] = []
-        self._lanes: List[np.ndarray] = []
+        frontier_keys: List[np.ndarray] = []   # host-table mode only
 
         if resume_from is not None:
-            (carry, res, frontier_blocks, n_states, n_vis,
-             depth) = self._load_spill_checkpoint(resume_from)
+            (carry, res, frontier_blocks, frontier_keys, n_states,
+             n_vis, depth) = self._load_spill_checkpoint(resume_from)
             root_blk = None
         else:
+            self._init_store()
+            if self.host_table:
+                self.hpt = HostPartitionedTable(
+                    self.W, partitions=self.partitions,
+                    part_cap=self.part_cap)
             # ---- roots (shared admit path: engine/bfs._dedup_roots) --
             roots, rk, pin_interiors = self._dedup_roots(seed_states)
             n_roots = len(rk)
@@ -497,16 +660,36 @@ class SpillEngine(Engine):
                             lpar=np.full((n_roots,), -1, np.int32),
                             llane=np.full((n_roots,), -1, np.int32),
                             linv=inv_r.T, lcon=con_r, n=n_roots)
+            if self.host_table:
+                root_blk["lfp"] = np.ascontiguousarray(
+                    rk.T.astype(np.uint32))
 
             n_states = 0       # running global id offset
             n_vis = n_roots
             depth = 0
             frontier_blocks = []
 
-        def harvest_block(blk):
+        def harvest_block(blk, keep=None):
             """Counts, violations, archives, next-frontier rows for one
-            spilled block; returns (rows, gids) for the frontier."""
+            spilled block; returns (rows, gids, fkeys) for the frontier
+            (fkeys None outside host-table mode).  ``keep`` is the
+            host-partition sweep's verdict: False rows were seen in an
+            earlier level (the device cache only errs fresh-ward) and
+            are dropped before any counting — exactly the rows the
+            in-HBM engine would never have admitted."""
             nonlocal n_states
+            if keep is not None and not keep.all():
+                kidx = np.nonzero(keep)[0]
+                sub = dict(
+                    rows={k: np.ascontiguousarray(v[..., kidx])
+                          for k, v in blk["rows"].items()},
+                    lpar=blk["lpar"][kidx], llane=blk["llane"][kidx],
+                    linv=blk["linv"][:, kidx], lcon=blk["lcon"][kidx],
+                    n=len(kidx))
+                if "lfp" in blk:
+                    sub["lfp"] = np.ascontiguousarray(
+                        blk["lfp"][:, kidx])
+                blk = sub
             n = blk["n"]
             res.distinct_states += n
             # C_OVERFLOW representability faults (engine/bfs finalize
@@ -532,41 +715,62 @@ class SpillEngine(Engine):
                     "the engine's int32 global-id width")
             con = blk["lcon"].astype(bool)
             if con.all():
-                return blk["rows"], gids
-            keep = np.nonzero(con)[0]
-            if not len(keep):
+                fk = (np.ascontiguousarray(blk["lfp"].T)
+                      if "lfp" in blk else None)
+                return blk["rows"], gids, fk
+            cidx = np.nonzero(con)[0]
+            if not len(cidx):
                 return None
-            return ({k: v[..., keep] for k, v in blk["rows"].items()},
-                    gids[keep])
+            fk = (np.ascontiguousarray(blk["lfp"][:, cidx].T)
+                  if "lfp" in blk else None)
+            return ({k: v[..., cidx] for k, v in blk["rows"].items()},
+                    gids[cidx], fk)
 
         def _take_last(rows, i):
             return {k: np.asarray(v[..., i]) for k, v in rows.items()}
 
         def flush_archives():
             """store_states: merge this level's spilled parts into the
-            classic batch-major per-level archive (trace()/get_state
-            are inherited unchanged)."""
+            per-level archive — streamed to the disk archive's memmaps
+            under ``archive_dir`` (host RSS stays level-bounded), or
+            concatenated into the classic in-RAM batch-major arrays
+            otherwise (trace()/get_state are inherited unchanged)."""
             if not self.store_states:
                 return
             parts = self._lvl_parts[-1]
             if not parts:
                 return
-            self._parents.append(np.concatenate(
-                [p["lpar"] for p in parts]))
-            self._lanes.append(np.concatenate(
-                [p["llane"] for p in parts]))
-            keys = parts[0]["rows"].keys()
-            self._states.append(
-                {k: np.moveaxis(np.concatenate(
-                    [p["rows"][k] for p in parts], axis=-1), -1, 0)
-                 for k in keys})
+            if self._arch is not None:
+                self._arch.append_level_parts(parts)
+            else:
+                self._parents.append(np.concatenate(
+                    [p["lpar"] for p in parts]))
+                self._lanes.append(np.concatenate(
+                    [p["llane"] for p in parts]))
+                keys = parts[0]["rows"].keys()
+                self._states.append(
+                    {k: np.moveaxis(np.concatenate(
+                        [p["rows"][k] for p in parts], axis=-1), -1, 0)
+                     for k in keys})
+            # the archive holds its own copies/files now; dropping the
+            # part refs keeps host RSS frontier-bounded
+            self._lvl_parts[-1] = []
 
         self._lvl_parts: List[List] = [[]]
         if root_blk is not None:
-            out = harvest_block(root_blk)
+            rkeep = None
+            if self.host_table:
+                # roots enter the host partitions through the same
+                # sweep as every level (all fresh by construction)
+                rkeep = self._sweep_level_keys(
+                    np.ascontiguousarray(root_blk["lfp"].T))
+            out = harvest_block(root_blk, rkeep)
             flush_archives()
             if out is not None:
-                frontier_blocks.append(out)
+                rows_r, gids_r, fk_r = out
+                frontier_blocks.append((rows_r, gids_r))
+                if fk_r is not None:
+                    frontier_keys.append(fk_r)
             res.generated_states = n_roots
         if stop_on_violation and res.violations:
             res.seconds = time.time() - t0
@@ -590,6 +794,8 @@ class SpillEngine(Engine):
             level_new = 0
             level_gen = 0
             next_blocks: List = []
+            next_keys: List = []
+            level_blks: List = []      # host-table: sweep at level end
             pending_blks: List = []
 
             def drain_gen():
@@ -606,19 +812,30 @@ class SpillEngine(Engine):
             def settle_blk(blk):
                 """Immediate int bookkeeping for a fresh pending spill
                 block; the numpy materialization + harvest run later
-                (FIFO) so the D2H DMA overlaps further chunk work."""
+                (FIFO) so the D2H DMA overlaps further chunk work.
+                n_vis tracks DEVICE-table occupancy either way; under
+                the host table, level_new waits for the sweep verdict
+                (a device-fresh row may be an older level's key)."""
                 nonlocal n_vis, level_new
                 if blk is not None:
                     n_vis += blk["n"]
-                    level_new += blk["n"]
+                    if not self.host_table:
+                        level_new += blk["n"]
                     pending_blks.append(blk)
 
             def drain_blks():
                 nonlocal pending_blks
                 for blk in pending_blks:
-                    out = harvest_block(self._materialize_blk(blk))
+                    blk = self._materialize_blk(blk)
+                    if self.host_table:
+                        # harvest defers to the level-end sweep: the
+                        # host partitions judge the whole level's keys
+                        # at once, in enumeration order
+                        level_blks.append(blk)
+                        continue
+                    out = harvest_block(blk)
                     if out is not None:
-                        next_blocks.append(out)
+                        next_blocks.append(out[:2])
                 pending_blks = []
 
             seg_iter = self._resegment(frontier_blocks, self.SEGF)
@@ -693,6 +910,26 @@ class SpillEngine(Engine):
             settle_blk(blk)
             drain_gen()
             drain_blks()
+            if self.host_table and level_blks:
+                # the level's keys — unique (device cache is complete
+                # over the level) and in enumeration order — meet the
+                # host partitions: rows whose key an earlier level
+                # archived are dropped everywhere at once
+                lkeys = np.concatenate(
+                    [np.ascontiguousarray(b["lfp"].T)
+                     for b in level_blks])
+                lkeep = self._sweep_level_keys(lkeys)
+                off = 0
+                for b in level_blks:
+                    nb = b["n"]
+                    kb = lkeep[off:off + nb]
+                    off += nb
+                    level_new += int(kb.sum())
+                    out = harvest_block(b, kb)
+                    if out is not None:
+                        rows_b, gids_b, fk_b = out
+                        next_blocks.append((rows_b, gids_b))
+                        next_keys.append(fk_b)
             flush_archives()
             if level_new == 0 and level_gen == 0:
                 # pruned-only frontier cannot occur here (host drops
@@ -704,11 +941,19 @@ class SpillEngine(Engine):
                     sum(int(g.shape[0]) for _r, g in next_blocks))
             frontier_blocks = next_blocks   # the expanded level's
             # blocks are freed here (rebind) unless archived
+            frontier_keys = next_keys
+            if self.host_table and n_vis > self.dev_keys:
+                # level boundary: the cache outgrew its HBM budget —
+                # reseed it with just the frontier's keys (the host
+                # partitions already hold everything archived)
+                fkeys = (np.concatenate(frontier_keys) if frontier_keys
+                         else np.zeros((0, self.W), np.uint32))
+                carry, n_vis = self._reseed_dev_table(carry, fkeys)
             if checkpoint_path is not None and \
                     depth % max(1, checkpoint_every) == 0:
                 self._save_spill_checkpoint(
                     checkpoint_path, carry, res, frontier_blocks,
-                    depth, n_states, n_vis)
+                    frontier_keys, depth, n_states, n_vis)
             if stop_on_violation and res.violations:
                 break
             if verbose:
@@ -743,7 +988,7 @@ class SpillEngine(Engine):
                          "fam_caps", "n_fblk")
 
     def _save_spill_checkpoint(self, path, carry, res, frontier_blocks,
-                               depth, n_states, n_vis):
+                               frontier_keys, depth, n_states, n_vis):
         # the table serializes SPARSE (occupied slot indices + keys),
         # and the sparsification runs ON DEVICE: deep runs pre-allocate
         # VCAP for the final level (2^28 slots = 4 GB of streams at
@@ -781,15 +1026,24 @@ class SpillEngine(Engine):
             fblk=[dict(g=np.asarray(g),
                        r={k: np.asarray(v) for k, v in rows.items()})
                   for rows, g in frontier_blocks])
+        if self.host_table:
+            # the authoritative visited set: sparse per-partition
+            # images (exact-image restore — no rehash drift) plus the
+            # frontier key blocks the reseed path needs
+            ckpt.update(self.hpt.state_dict())
+            ckpt["fkey"] = [np.asarray(fk) for fk in frontier_keys]
         n_front = sum(int(g.shape[0]) for _r, g in frontier_blocks)
-        ckpt_write(path, ckpt, self.store_states, self._parents,
-                   self._lanes, self._states, res, dict(
+        parents, lanes, states, arch_meta = self._ckpt_store_args()
+        ckpt_write(path, ckpt, self.store_states, parents,
+                   lanes, states, res, dict(
                        spill=True, depth=depth, n_states=n_states,
                        n_vis=n_vis, n_front=n_front,
                        n_fblk=len(frontier_blocks),
                        SEGL=self.SEGL, SEGF=self.SEGF, VCAP=self.VCAP,
                        FCAP=self.FCAP, OCAP=self.OCAP,
                        fam_caps=list(self.FAM_CAPS),
+                       host_table=self.host_table,
+                       partitions=self.partitions, **arch_meta,
                        layout=2, chunk=self.chunk, cfg=repr(self.cfg)))
 
     def _load_spill_checkpoint(self, path):
@@ -831,13 +1085,30 @@ class SpillEngine(Engine):
             gids = z[f"carry|fblk|{i}|g"]
             rows = {k: z[f"carry|fblk|{i}|r|{k}"] for k in row_keys}
             frontier_blocks.append((rows, gids))
+        if bool(meta.get("host_table")) != self.host_table:
+            raise CheckpointError(
+                f"{path}: checkpoint was written with host_table="
+                f"{bool(meta.get('host_table'))}; resume with the "
+                "same setting")
+        frontier_keys = []
+        if self.host_table:
+            if meta.get("partitions") != self.partitions:
+                raise CheckpointError(
+                    f"{path}: checkpoint has {meta.get('partitions')} "
+                    f"host-table partitions; engine has "
+                    f"{self.partitions} — resume with the same "
+                    "--partitions (counts are P-invariant, but the "
+                    "serialized images are not)")
+            self.hpt = HostPartitionedTable.from_state(
+                lambda nm: z["carry|" + nm])
+            frontier_keys = [np.asarray(z[f"carry|fkey|{i}"])
+                             for i in range(meta["n_fblk"])]
         template = {"lvl": carry["lvl"]}       # archive key template
-        self._parents, self._lanes, self._states = ckpt_archives(
-            z, meta, template, self.store_states)
+        self._load_archives(path, z, meta, template)
         res = ckpt_result(z, meta)
         z.close()             # all arrays extracted; don't leak the fd
-        return (carry, res, frontier_blocks, meta["n_states"],
-                meta["n_vis"], meta["depth"])
+        return (carry, res, frontier_blocks, frontier_keys,
+                meta["n_states"], meta["n_vis"], meta["depth"])
 
     # ------------------------------------------------------------------
 

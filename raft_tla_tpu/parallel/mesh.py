@@ -144,6 +144,11 @@ class ShardedEngine(Engine):
         # step-atomic trip discipline: off here (whole-level journal
         # replay); the spill-composed subclass turns it on
         self._step_atomic = False
+        # appended rows' fingerprints ride the level shard (lkey) only
+        # when the spill-composed subclass runs its host-partitioned
+        # table: they feed the per-device partition sweep + cache
+        # reseed (parallel/spill_mesh; engine/host_table)
+        self._track_keys = False
         self._level_jit = jax.jit(self._sharded_level_call,
                                   donate_argnums=0, static_argnums=1)
 
@@ -406,6 +411,13 @@ class ShardedEngine(Engine):
         inv, con = inv_all[lidx], con_all[lidx]
         lvl = {k: lax.dynamic_update_slice_in_dim(v, rows[k], start, 0)
                for k, v in c["lvl"].items()}
+        extra = {}
+        if self._track_keys:
+            # the appended rows' dedup keys (stage-2 replacements swap
+            # content behind the SAME key, so no update there)
+            rkey = jnp.stack(recv_key, axis=-1)            # [M, W]
+            extra["lkey"] = lax.dynamic_update_slice(
+                c["lkey"], rkey[lidx], (start, 0))
         lpar = lax.dynamic_update_slice_in_dim(
             c["lpar"], recv_pgid[lidx], start, 0)
         llane = lax.dynamic_update_slice_in_dim(
@@ -452,7 +464,7 @@ class ShardedEngine(Engine):
         lcon = lcon.at[widx2].set(con_all, mode="drop")
         return dict(c, vis=table, claims=claims, lvl=lvl, lpar=lpar,
                     llane=llane, lpfp=lpfp, jslot=jslot, linv=linv,
-                    lcon=lcon, lrow=lrow,
+                    lcon=lcon, lrow=lrow, **extra,
                     n_lvl=jnp.minimum(c["n_lvl"] + n_fresh, LB - M),
                     n_gen=n_gen, ovf=ovf, fovf=fovf, sovf=sovf,
                     hovf=hovf, famx=famx, trip_base=trip_base,
@@ -534,7 +546,11 @@ class ShardedEngine(Engine):
         zeros = {k: jnp.zeros((D, LB) + v.shape, dtype=v.dtype)
                  for k, v in one.items()}
         n_inv = len(self.inv_names)
+        extra = {}
+        if self._track_keys:
+            extra["lkey"] = jnp.full((D, LB, self.W), U32MAX)
         return dict(
+            **extra,
             vis=tuple(jnp.full((D, VB), U32MAX) for _ in range(self.W)),
             claims=jnp.full((D, VB), U32MAX),
             # table slot -> this-level row (content-canonical stage 2)

@@ -50,6 +50,7 @@ from jax import lax
 
 from ..config import ModelConfig
 from ..engine.bfs import CheckResult, U32MAX, Violation
+from ..engine.host_table import HostPartitionedTable, insert_np
 from ..engine.spill import SpillEngine
 from ..models.raft import init_state
 from ..ops.codec import C_OVERFLOW, decode, encode, narrow
@@ -68,7 +69,9 @@ class SpilledShardedEngine(ShardedEngine):
     ShardedEngine; everything else follows it too."""
 
     def __init__(self, cfg: ModelConfig, devices=None, chunk: int = 512,
-                 store_states: bool = False, **kw):
+                 store_states: bool = False, host_table: bool = False,
+                 partitions: int = 4, part_cap: int = 1 << 12,
+                 dev_keys: Optional[int] = None, **kw):
         if store_states:
             raise NotImplementedError(
                 "SpilledShardedEngine does not archive states yet — "
@@ -76,6 +79,26 @@ class SpilledShardedEngine(ShardedEngine):
                 "range, or SpillEngine single-device")
         super().__init__(cfg, devices=devices, chunk=chunk,
                          store_states=False, **kw)
+        # host-partitioned visited table, mesh composition
+        # (engine/host_table): hash-ownership routes a key to its owner
+        # device (fingerprint stream W-1 mod D) exactly as before, and
+        # each device's authoritative visited set moves to a
+        # PER-DEVICE prefix-partitioned host table (stream 0 top bits
+        # — an independent axis, so the two partitionings compose).
+        # The table shard becomes a bounded per-device cache, complete
+        # over the running level, reseeded from the frontier at level
+        # boundaries; level keys meet the host partitions once per
+        # level, per device, in the engine's deterministic
+        # (spill-event, device) order, so counts are exactly those of
+        # the un-composed engine.
+        self.host_table = bool(host_table)
+        self._track_keys = self.host_table
+        self.partitions = int(partitions)
+        self.part_cap = int(part_cap)
+        self.VB0 = self.VB
+        self.dev_keys = (int(dev_keys) if dev_keys
+                         else int(self._LOAD_MAX * self.VB))
+        self.hpts = None               # per-device tables, per check()
         # the classic engine's LB >= 4*FC floor is a thrash heuristic
         # for whole-level replays; this engine replays only single
         # steps, so the shard capacity honors the caller's lcap down
@@ -134,19 +157,26 @@ class SpilledShardedEngine(ShardedEngine):
             nq = SpillEngine._quantize(nmax, self.LB, floor=1 << 8)
             fn = self._mslice_cache.get(nq)
             if fn is None:
-                def impl(lvl, lpar, llane, linv, lcon, nq=nq):
-                    return (
+                def impl(lvl, lpar, llane, linv, lcon, lkey=None,
+                         nq=nq):
+                    out = (
                         {k: lax.slice_in_dim(v, 0, nq, axis=1)
                          for k, v in lvl.items()},
                         lax.slice_in_dim(lpar, 0, nq, axis=1),
                         lax.slice_in_dim(llane, 0, nq, axis=1),
                         lax.slice_in_dim(linv, 0, nq, axis=1),
                         lax.slice_in_dim(lcon, 0, nq, axis=1))
+                    if lkey is not None:
+                        out += (lax.slice_in_dim(lkey, 0, nq, axis=1),)
+                    return out
                 fn = self._mslice_cache[nq] = jax.jit(impl)
-            lvl, lpar, llane, linv, lcon = jax.tree_util.tree_map(
+            sliced = jax.tree_util.tree_map(
                 np.asarray,
                 fn(carry["lvl"], carry["lpar"], carry["llane"],
-                   carry["linv"], carry["lcon"]))
+                   carry["linv"], carry["lcon"],
+                   carry["lkey"] if self._track_keys else None))
+            lvl, lpar, llane, linv, lcon = sliced[:5]
+            lkey = sliced[5] if self._track_keys else None
             for d in range(self.D):
                 n = int(nl[d])
                 if n:
@@ -158,6 +188,9 @@ class SpilledShardedEngine(ShardedEngine):
                         linv=np.ascontiguousarray(linv[d, :n]),
                         lcon=np.ascontiguousarray(lcon[d, :n]),
                         n=n)
+                    if lkey is not None:
+                        blks[d]["lkey"] = np.ascontiguousarray(
+                            lkey[d, :n])
         # reset the per-level device state.  lrow reset closes the
         # stage-2 replacement epoch (module docstring): replacements
         # must never target rows that just left the device.
@@ -270,6 +303,10 @@ class SpilledShardedEngine(ShardedEngine):
             {k: jnp.asarray(v) for k, v in roots.items()}))
         roots_n = narrow(lay, roots)
 
+        if self.host_table:
+            self.hpts = [HostPartitionedTable(
+                W, partitions=self.partitions, part_cap=self.part_cap)
+                for _ in range(D)]
         carry = self._fresh_sharded_carry()
         vis_np = [np.array(t) for t in carry["vis"]]   # writable copies
         root_blks = [None] * D
@@ -288,6 +325,11 @@ class SpilledShardedEngine(ShardedEngine):
                 lpar=np.full((len(idx),), -1, np.int32),
                 llane=np.full((len(idx),), -1, np.int32),
                 linv=inv_r[idx], lcon=con_r[idx], n=len(idx))
+            if self.host_table:
+                root_blks[d]["lkey"] = rkd.astype(np.uint32)
+                # roots enter the per-device host partitions through
+                # the same sweep as every level (all fresh)
+                self.hpts[d].sweep(root_blks[d]["lkey"])
         carry["vis"] = tuple(jnp.asarray(v) for v in vis_np)
 
         n_states = 0
@@ -328,19 +370,25 @@ class SpilledShardedEngine(ShardedEngine):
                         "state-id space exhausted (2^31 ids)")
                 con = blk["lcon"].astype(bool)
                 if con.all():
-                    out[d] = (blk["rows"], gids)
+                    out[d] = (blk["rows"], gids, blk.get("lkey"))
                 elif con.any():
                     keep = np.nonzero(con)[0]
                     out[d] = ({k: v[keep]
                                for k, v in blk["rows"].items()},
-                              gids[keep])
+                              gids[keep],
+                              blk["lkey"][keep]
+                              if "lkey" in blk else None)
             return out
 
         frontier: List[List] = [[] for _ in range(D)]
+        frontier_keys: List[List] = [[] for _ in range(D)]
         root_front = harvest_blocks(root_blks)
         for d in range(D):
             if root_front[d] is not None:
-                frontier[d].append(root_front[d])
+                rows_r, gids_r, fk_r = root_front[d]
+                frontier[d].append((rows_r, gids_r))
+                if fk_r is not None:
+                    frontier_keys[d].append(fk_r)
         res.generated_states = len(rk)
         if stop_on_violation and res.violations:
             res.seconds = time.time() - t0
@@ -354,17 +402,26 @@ class SpilledShardedEngine(ShardedEngine):
             level_new = 0
             level_gen = 0
             next_frontier: List[List] = [[] for _ in range(D)]
+            next_keys: List[List] = [[] for _ in range(D)]
+            level_events: List[List] = []    # host-table: defer harvest
 
             def settle(blks):
                 nonlocal level_new, n_vis
                 for d in range(D):
                     if blks[d] is not None:
                         n_vis[d] += blks[d]["n"]
-                        level_new += blks[d]["n"]
+                        if not self.host_table:
+                            level_new += blks[d]["n"]
+                if self.host_table:
+                    # harvest defers to the level-end per-device
+                    # partition sweep (module docstring)
+                    if any(b is not None for b in blks):
+                        level_events.append(blks)
+                    return
                 outs = harvest_blocks(blks)
                 for d in range(D):
                     if outs[d] is not None:
-                        next_frontier[d].append(outs[d])
+                        next_frontier[d].append(outs[d][:2])
 
             for seg in self._resegment_dev(frontier, SEGB):
                 carry = self._sgrow_table_if_needed(carry, n_vis)
@@ -384,6 +441,38 @@ class SpilledShardedEngine(ShardedEngine):
             nl = np.asarray(carry["n_lvl"])
             carry, blks = self._fetch_shards(carry, nl)
             settle(blks)
+            if self.host_table and level_events:
+                # per-device key streams in (spill-event) order: each
+                # device's keys are unique within the level (its table
+                # shard is complete over the level) and disjoint across
+                # devices (hash-ownership), so the sweeps are
+                # independent; the keep verdicts then filter the
+                # event-ordered blocks so gid assignment keeps the
+                # engine's deterministic (event, device) order
+                for d in range(D):
+                    dev_blks = [ev[d] for ev in level_events
+                                if ev[d] is not None]
+                    if not dev_blks:
+                        continue
+                    keys = np.concatenate(
+                        [b["lkey"][:b["n"]] for b in dev_blks])
+                    keep = self.hpts[d].sweep(keys.astype(np.uint32))
+                    off = 0
+                    for b in dev_blks:
+                        nb = b["n"]
+                        b["_keep"] = keep[off:off + nb]
+                        off += nb
+                for ev in level_events:
+                    fblks = [self._filter_blk(ev[d]) for d in range(D)]
+                    for d in range(D):
+                        if fblks[d] is not None:
+                            level_new += fblks[d]["n"]
+                    outs = harvest_blocks(fblks)
+                    for d in range(D):
+                        if outs[d] is not None:
+                            rows_b, gids_b, fk_b = outs[d]
+                            next_frontier[d].append((rows_b, gids_b))
+                            next_keys[d].append(fk_b)
             res.generated_states += level_gen
             if level_new == 0 and level_gen == 0:
                 depth -= 1
@@ -392,6 +481,12 @@ class SpilledShardedEngine(ShardedEngine):
                     int(g.shape[0]) for q in next_frontier
                     for _r, g in q))
             frontier = next_frontier
+            frontier_keys = next_keys
+            if self.host_table and int(n_vis.max()) > self.dev_keys:
+                # level boundary: reseed every table shard with just
+                # its frontier's keys (the host partitions answer for
+                # everything archived)
+                carry, n_vis = self._reseed_shards(carry, frontier_keys)
             if stop_on_violation and res.violations:
                 break
             if verbose:
@@ -402,6 +497,57 @@ class SpilledShardedEngine(ShardedEngine):
         res.depth = depth
         res.seconds = time.time() - t0
         return res
+
+    # -- host-partitioned table composition ---------------------------
+
+    @staticmethod
+    def _filter_blk(blk):
+        """Apply a sweep keep-verdict to one spilled block (rows whose
+        key an earlier level archived drop before any counting)."""
+        if blk is None or "_keep" not in blk:
+            return blk
+        kb = blk.pop("_keep")
+        if kb.all():
+            return blk
+        kidx = np.nonzero(kb)[0]
+        if not len(kidx):
+            return None
+        return dict(
+            rows={k: np.ascontiguousarray(v[kidx])
+                  for k, v in blk["rows"].items()},
+            lpar=blk["lpar"][kidx], llane=blk["llane"][kidx],
+            linv=blk["linv"][kidx], lcon=blk["lcon"][kidx],
+            lkey=blk["lkey"][kidx], n=len(kidx))
+
+    def _reseed_shards(self, carry, frontier_keys):
+        """Reset every device's table shard to its own frontier's keys
+        at (near) the initial capacity.  The shard images build
+        host-side with engine/host_table.insert_np — the numpy twin of
+        the device claim-insert, same home hash and probe walk — and
+        upload in one piece; claims and the stage-2 lrow map reset with
+        them."""
+        D, W = self.D, self.W
+        fk = [(np.concatenate(q).astype(np.uint32) if q else
+               np.zeros((0, W), np.uint32)) for q in frontier_keys]
+        nmax = max(k.shape[0] for k in fk)
+        self.VB = self.VB0
+        while nmax + self.LB > self._LOAD_MAX * self.VB:
+            self.VB *= 4
+        vis_np = [np.full((D, self.VB), np.uint32(0xFFFFFFFF),
+                          np.uint32) for _ in range(W)]
+        for d in range(D):
+            if not fk[d].shape[0]:
+                continue
+            img = np.full((W, self.VB), np.uint32(0xFFFFFFFF),
+                          np.uint32)
+            insert_np(img, fk[d])
+            for w in range(W):
+                vis_np[w][d] = img[w]
+        carry = dict(carry,
+                     vis=tuple(jnp.asarray(v) for v in vis_np),
+                     claims=jnp.full((D, self.VB), U32MAX),
+                     lrow=jnp.full((D, self.VB), -1, jnp.int32))
+        return carry, np.array([k.shape[0] for k in fk], np.int64)
 
     # -- trip handling ------------------------------------------------
 

@@ -12,6 +12,15 @@ from typing import Dict, List
 import numpy as np
 
 
+# the probe-walk contract every visited-table image shares (device
+# tables in engine/bfs + engine/spill, host partitions in
+# engine/host_table): home slot = fmix32-fold of the key streams
+# seeded with this salt.  ONE definition — a drifted twin would walk
+# different probe chains on host vs device and silently inflate
+# distinct counts.
+HOME_SALT = 0x9E3779B9
+
+
 def fmix32_int(x: int) -> int:
     """Host twin of engine.fingerprint.fmix32 (murmur3 finalizer) on
     plain ints — used for host-side probe placement of root/seed keys."""
@@ -21,6 +30,18 @@ def fmix32_int(x: int) -> int:
     x ^= x >> 13
     x = (x * 0xC2B2AE35) & 0xFFFFFFFF
     x ^= x >> 16
+    return x
+
+
+def fmix32_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized numpy twin of the same finalizer — host-side image
+    building/probing over whole key arrays (engine/host_table)."""
+    x = x.astype(np.uint32, copy=True)
+    x ^= x >> np.uint32(16)
+    x *= np.uint32(0x85EBCA6B)
+    x ^= x >> np.uint32(13)
+    x *= np.uint32(0xC2B2AE35)
+    x ^= x >> np.uint32(16)
     return x
 
 

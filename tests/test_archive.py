@@ -1,0 +1,177 @@
+"""Disk-backed per-level trace archives (engine/archive): the memmap'd
+files must replay traces bit-identically to the historical in-RAM
+archive path, survive checkpoint resume via attach+truncate, and keep
+the growing per-level arrays OFF the host heap (the round-5 ~21 GB
+trace-archive ceiling, BASELINE.md)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+from raft_tla_tpu.engine.archive import ArchiveError, DiskArchive
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+
+# -- unit level: the file format round-trips exactly -------------------
+
+
+def _mk_level(rng, n, with_matrix=True):
+    parents = rng.integers(-1, 50, size=n).astype(np.int32)
+    lanes = rng.integers(-1, 8, size=n).astype(np.int32)
+    states = {"ct": rng.integers(0, 5, size=n).astype(np.int8),
+              "votes": rng.integers(0, 2, size=(n, 3)).astype(np.uint8)}
+    if not with_matrix:
+        states.pop("votes")
+    return parents, lanes, states
+
+
+def test_disk_archive_roundtrip_batch_major(tmp_path):
+    rng = np.random.default_rng(5)
+    arch = DiskArchive(str(tmp_path / "run"))
+    levels = [_mk_level(rng, n) for n in (3, 17, 1)]
+    for par, lane, st in levels:
+        arch.append_level(par, lane, st)
+    assert arch.n_levels == 3 and arch.total_rows == 21
+    for i, (par, lane, st) in enumerate(levels):
+        np.testing.assert_array_equal(arch.parents(i), par)
+        np.testing.assert_array_equal(arch.lanes(i), lane)
+        got = arch.states(i)
+        for k in st:
+            np.testing.assert_array_equal(got[k], st[k])
+    # global-id addressing crosses level boundaries
+    assert arch.locate(0) == (0, 0)
+    assert arch.locate(3) == (1, 0)
+    assert arch.locate(20) == (2, 0)
+    par, lane = arch.parent_lane(4)
+    assert (par, lane) == (int(levels[1][0][1]), int(levels[1][1][1]))
+    row = arch.state_row(5)
+    np.testing.assert_array_equal(row["ct"], levels[1][2]["ct"][2])
+
+
+def test_disk_archive_parts_stream_batch_last(tmp_path):
+    """Spill parts arrive batch-LAST (the device block layout) and may
+    be over-allocated past n; the archive must transpose and trim
+    per part without a whole-level concat buffer."""
+    rng = np.random.default_rng(9)
+    arch = DiskArchive(str(tmp_path / "run"))
+    par, lane, st = _mk_level(rng, 10)
+    parts = []
+    for lo, hi in ((0, 4), (4, 10)):
+        m = hi - lo
+        pad = 3                      # over-allocated tail, must be cut
+        rows = {k: np.moveaxis(
+            np.concatenate([v[lo:hi], v[:pad]]), 0, -1)
+            for k, v in st.items()}
+        parts.append(dict(n=m, lpar=np.concatenate(
+            [par[lo:hi], par[:pad]]),
+            llane=np.concatenate([lane[lo:hi], lane[:pad]]),
+            rows=rows))
+    arch.append_level_parts(parts)
+    np.testing.assert_array_equal(arch.parents(0), par)
+    np.testing.assert_array_equal(arch.lanes(0), lane)
+    for k, v in st.items():
+        np.testing.assert_array_equal(arch.states(0)[k], v)
+
+
+def test_disk_archive_attach_truncate_resume(tmp_path):
+    """attach=True reopens a killed run's completed levels; truncate
+    drops levels past a checkpoint so the resumed run re-appends them
+    — and refuses an archive shorter than the checkpoint expects."""
+    rng = np.random.default_rng(13)
+    root = str(tmp_path / "run")
+    arch = DiskArchive(root)
+    levels = [_mk_level(rng, n) for n in (4, 6, 5)]
+    for par, lane, st in levels:
+        arch.append_level(par, lane, st)
+    re = DiskArchive(root, attach=True)
+    assert re.level_rows == [4, 6, 5]
+    re.truncate(1)
+    assert re.n_levels == 1 and not os.path.exists(
+        os.path.join(root, "lvl0001.parents.npy"))
+    np.testing.assert_array_equal(re.parents(0), levels[0][0])
+    with pytest.raises(ArchiveError, match="wrong"):
+        re.truncate(3)
+    with pytest.raises(ArchiveError, match="not a readable"):
+        DiskArchive(str(tmp_path / "nope"), attach=True)
+    # meta is rewritten atomically: no .tmp survives a clean append
+    assert not os.path.exists(os.path.join(root, "meta.json.tmp"))
+    assert json.load(open(os.path.join(root, "meta.json")))[
+        "level_rows"] == [4]
+
+
+# -- engine level: disk path ≡ in-RAM path on a violation trace --------
+
+
+def test_engine_trace_roundtrip_disk_vs_ram(tmp_path):
+    """The satellite's core claim: a violation trace replayed through
+    the memmap'd per-level files matches the in-RAM archive path
+    exactly — labels, states, and every archived row."""
+    from raft_tla_tpu.engine.bfs import Engine
+    cfg = MICRO.with_(invariants=("FirstBecomeLeader",))
+    e_ram = Engine(cfg, chunk=64, store_states=True)
+    r_ram = e_ram.check(stop_on_violation=True)
+    e_dsk = Engine(cfg, chunk=64, store_states=True,
+                   archive_dir=str(tmp_path / "arch"))
+    r_dsk = e_dsk.check(stop_on_violation=True)
+    assert r_dsk.distinct_states == r_ram.distinct_states
+    assert r_dsk.violations[0].state_id == r_ram.violations[0].state_id
+
+    # the disk engine holds NO in-RAM archive — rows live on disk only
+    assert e_dsk._states == [] and e_dsk._parents == []
+    assert e_dsk._arch.total_rows == r_dsk.distinct_states
+
+    gid = r_dsk.violations[0].state_id
+    tr_ram, tr_dsk = e_ram.trace(gid), e_dsk.trace(gid)
+    assert [lbl for lbl, _s in tr_dsk] == [lbl for lbl, _s in tr_ram]
+    assert [s for _l, s in tr_dsk] == [s for _l, s in tr_ram]
+    # and every archived row matches, not just the witness chain
+    for g in range(r_dsk.distinct_states):
+        ram_row = e_ram.get_state_arrays(g)
+        dsk_row = e_dsk.get_state_arrays(g)
+        for k in ram_row:
+            np.testing.assert_array_equal(ram_row[k], dsk_row[k])
+
+
+@pytest.mark.slow
+def test_spill_engine_archive_dir_and_resume(tmp_path):
+    """SpillEngine + archive_dir: spilled parts stream to the memmaps
+    (batch-last path), traces replay, and a checkpoint resume
+    reattaches the SAME archive dir — truncating past-checkpoint
+    levels so the resumed run is bit-identical."""
+    from raft_tla_tpu.engine.bfs import CheckpointError
+    from raft_tla_tpu.engine.spill import SpillEngine
+    cfg = MICRO.with_(invariants=("FirstBecomeLeader",))
+    kw = dict(chunk=64, store_states=True, seg=1 << 10, vcap=1 << 12,
+              sync_every=2)
+    e_ram = SpillEngine(cfg, **kw)
+    r_ram = e_ram.check()
+    e_dsk = SpillEngine(cfg, archive_dir=str(tmp_path / "a1"), **kw)
+    r_dsk = e_dsk.check()
+    assert r_dsk.distinct_states == r_ram.distinct_states
+    assert r_dsk.level_sizes == r_ram.level_sizes
+    gid = r_dsk.violations[0].state_id
+    assert [lbl for lbl, _s in e_dsk.trace(gid)] == \
+        [lbl for lbl, _s in e_ram.trace(gid)]
+
+    # checkpoint/resume reattaches the archive and stays identical
+    ckpt = str(tmp_path / "s.ckpt")
+    a2 = str(tmp_path / "a2")
+    SpillEngine(cfg, archive_dir=a2, **kw).check(
+        max_depth=8, checkpoint_path=ckpt)
+    e_res = SpillEngine(cfg, archive_dir=a2, **kw)
+    r_res = e_res.check(resume_from=ckpt)
+    assert r_res.distinct_states == r_ram.distinct_states
+    assert e_res._arch.total_rows == r_ram.distinct_states
+    assert [lbl for lbl, _s in e_res.trace(gid)] == \
+        [lbl for lbl, _s in e_ram.trace(gid)]
+    # resuming a disk-archive checkpoint WITHOUT the dir is refused
+    with pytest.raises(CheckpointError, match="archive"):
+        SpillEngine(cfg, **kw).check(resume_from=ckpt)

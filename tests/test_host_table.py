@@ -1,0 +1,204 @@
+"""Differential tests for the host-partitioned visited table
+(engine/host_table + engine/spill host_table=True): the authoritative
+visited set lives in host RAM as fingerprint-prefix partitions and the
+HBM table degrades to a bounded cache, yet every count stays
+bit-identical to the in-HBM engine and the Python oracle.
+
+Capacities here are squeezed so the streaming dedup actually engages:
+``dev_keys`` is forced far below the config's distinct-key count, so
+the device cache reseeds at level boundaries and the host-partition
+sweep is what drops re-generated old-level keys — exactly the
+beyond-the-HBM-ceiling regime the tentpole targets, exercised on the
+CPU backend at micro scale (ISSUE 1 acceptance; the budgeted
+configs #1/#2 shapes need the TPU host's reference cfgs)."""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+from raft_tla_tpu.engine.host_table import (HostPartitionedTable,
+                                            insert_np, member_np)
+from raft_tla_tpu.engine.spill import SpillEngine
+from raft_tla_tpu.models.explore import explore
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+# dev_keys=64 << the micro space's distinct count: the device cache
+# reseeds after nearly every level, so dedup against anything older
+# than the frontier can ONLY come from the host partitions
+SQUEEZE = dict(chunk=64, store_states=False, seg=1 << 10, vcap=1 << 12,
+               sync_every=2, host_table=True, part_cap=1 << 6,
+               dev_keys=64)
+
+
+def _match(r, want):
+    assert r.distinct_states == want.distinct_states
+    assert r.depth == want.depth
+    assert r.generated_states == want.generated_states
+    assert len(r.violations) == len(want.violations)
+    assert r.level_sizes == want.level_sizes
+
+
+def test_host_table_partition_count_invariance():
+    """P=1 ≡ P=4 ≡ P=8: bit-identical distinct counts and level sizes
+    (the partition id is a pure function of the key, so P only changes
+    the sweep's batching, never its verdict).  The SQUEEZE capacities
+    force the streaming path for real (ISSUE 1 acceptance: table
+    capacity below the distinct-key count, 0 overflow faults): the
+    oracle `want` equals the in-HBM engine's counts on this cfg
+    (test_spill pins that), so matching it here IS the differential
+    against the in-HBM engine."""
+    want = explore(MICRO)
+    assert want.distinct_states > 64          # the squeeze is real
+    results = {}
+    for P in (1, 4, 8):
+        eng = SpillEngine(MICRO, partitions=P, **SQUEEZE)
+        r = eng.check()
+        _match(r, want)
+        assert r.overflow_faults == 0
+        # the host table is the authority: it holds every distinct key
+        assert eng.hpt.n_keys == want.distinct_states
+        assert eng.hpt.P == P
+        # every partition saw keys, and the forced-tiny 2^6 images
+        # rehash-grew under the load bound
+        assert all(c > 0 for c in eng.hpt.counts)
+        assert any(eng.hpt.cap(p) > 1 << 6 for p in range(P))
+        results[P] = (r.distinct_states, tuple(r.level_sizes))
+    assert results[1] == results[4] == results[8]
+
+
+def test_host_table_traces_and_violations():
+    """store_states path under the host table: first-seen semantics
+    (which copy of a state is archived) must be preserved, so traces
+    replay exactly as the oracle's witness."""
+    cfg = MICRO.with_(invariants=("FirstBecomeLeader",))
+    want = explore(cfg, stop_on_violation=True, trace_violations=True)
+    eng = SpillEngine(cfg, partitions=4,
+                      **dict(SQUEEZE, store_states=True))
+    r = eng.check(stop_on_violation=True)
+    assert r.violations and want.violations
+    assert r.violations[0].invariant == "FirstBecomeLeader"
+    tr = eng.trace(r.violations[0].state_id)
+    assert len(tr) - 1 == len(want.violations[0].trace)
+    assert tr[0][0] == "Init"
+
+
+@pytest.mark.slow
+def test_host_table_checkpoint_resume_identical(tmp_path):
+    """Interrupt mid-run, resume: the partition images restore
+    exact-image (no rehash drift) and the run lands bit-identical to
+    an uninterrupted one."""
+    full = SpillEngine(MICRO, partitions=4, **SQUEEZE).check()
+
+    ckpt = str(tmp_path / "ht.ckpt")
+    part = SpillEngine(MICRO, partitions=4, **SQUEEZE).check(
+        max_depth=8, checkpoint_path=ckpt)
+    assert part.distinct_states < full.distinct_states
+
+    e2 = SpillEngine(MICRO, partitions=4, **SQUEEZE)
+    resumed = e2.check(resume_from=ckpt)
+    assert resumed.distinct_states == full.distinct_states
+    assert resumed.depth == full.depth
+    assert resumed.generated_states == full.generated_states
+    assert resumed.level_sizes == full.level_sizes
+    assert e2.hpt.n_keys == full.distinct_states
+
+
+def test_host_table_checkpoint_mismatch_rejected(tmp_path):
+    """Resume must repeat the checkpoint's host-table settings: the
+    serialized images are per-P, and a silent fallback would change
+    dedup authority mid-run."""
+    from raft_tla_tpu.engine.bfs import CheckpointError
+    ckpt = str(tmp_path / "ht.ckpt")
+    SpillEngine(MICRO, partitions=4, **SQUEEZE).check(
+        max_depth=6, checkpoint_path=ckpt)
+    with pytest.raises(CheckpointError, match="host_table"):
+        SpillEngine(MICRO, chunk=64, store_states=False, seg=1 << 10,
+                    vcap=1 << 12).check(resume_from=ckpt)
+    with pytest.raises(CheckpointError, match="partitions"):
+        SpillEngine(MICRO, partitions=8, **SQUEEZE).check(
+            resume_from=ckpt)
+
+
+# -- overflow / bail paths (forced-tiny partition) ---------------------
+
+
+def test_insert_np_bails_on_full_image():
+    """The host-side claim-insert must fail LOUD, not loop or corrupt,
+    when a partition image has no empty slot left."""
+    rng = np.random.default_rng(7)
+    img = np.full((2, 64), np.uint32(0xFFFFFFFF), np.uint32)
+    keys = rng.integers(0, 2 ** 32 - 2, size=(64, 2), dtype=np.uint64
+                        ).astype(np.uint32)
+    keys = np.unique(keys, axis=0)
+    insert_np(img, keys)                      # fills every slot it can
+    assert not (img == np.uint32(0xFFFFFFFF)).all(axis=0).any() or \
+        keys.shape[0] < 64
+    more = rng.integers(0, 2 ** 32 - 2, size=(8, 2), dtype=np.uint64
+                        ).astype(np.uint32)
+    if keys.shape[0] == 64:                   # truly full image
+        with pytest.raises(RuntimeError, match="full"):
+            insert_np(img, more)
+
+
+def test_member_np_matches_insert_np():
+    """Host membership is exact over inserted keys and clean misses."""
+    rng = np.random.default_rng(11)
+    img = np.full((2, 256), np.uint32(0xFFFFFFFF), np.uint32)
+    keys = np.unique(rng.integers(0, 2 ** 32 - 2, size=(80, 2),
+                                  dtype=np.uint64).astype(np.uint32),
+                     axis=0)
+    insert_np(img, keys)
+    assert member_np(img, keys).all()
+    misses = keys.copy()
+    misses[:, 1] ^= np.uint32(1)
+    fresh = ~(misses[:, None] == keys[None]).all(-1).any(1)
+    assert not member_np(img, misses[fresh]).any()
+
+
+def test_sweep_bails_on_poisoned_partition():
+    """Device-side sweep bail: a partition image with NO empty slot
+    (forced behind reserve()'s back) can never terminate the probe
+    walk — the engine must raise, not return a wrong verdict."""
+    eng = SpillEngine(MICRO, partitions=1, **SQUEEZE)
+    eng.hpt = HostPartitionedTable(eng.W, partitions=1,
+                                   part_cap=1 << 6)
+    # poison: every slot occupied by a key that matches nothing
+    eng.hpt.imgs[0][:] = np.uint32(0)
+    eng.hpt.counts[0] = 0                     # reserve() won't grow it
+    keys = np.full((4, eng.W), np.uint32(123), np.uint32)
+    keys[:, 0] = np.arange(1, 5, dtype=np.uint32)
+    with pytest.raises(RuntimeError, match="full"):
+        eng._sweep_level_keys(keys)
+
+
+def test_host_table_partition_ids_pure_and_bounded():
+    """Partition ids come from stream 0's top bits only: every id is
+    in range and P=1 collapses to a single bucket."""
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 2 ** 32 - 1, size=(1000, 2),
+                        dtype=np.uint64).astype(np.uint32)
+    for P in (1, 2, 8):
+        t = HostPartitionedTable(2, partitions=P)
+        pids = t.partition_ids(keys)
+        assert pids.min() >= 0 and pids.max() < P
+        if P > 1:
+            assert (pids == (keys[:, 0] >> np.uint32(
+                32 - t.bits)).astype(np.int64)).all()
+    with pytest.raises(ValueError, match="power of two"):
+        HostPartitionedTable(2, partitions=3)
+
+
+@pytest.mark.slow
+def test_host_table_fovf_growth_composition():
+    """Family-cap growth replays compose with the host sweep: tiny
+    fam caps force fovf grow-and-replay while the table streams."""
+    want = explore(MICRO)
+    eng = SpillEngine(MICRO, partitions=4, fcap=64, **SQUEEZE)
+    eng.FAM_CAPS = tuple(min(c, 16) for c in eng.FAM_CAPS)
+    r = eng.check()
+    _match(r, want)

@@ -124,3 +124,34 @@ def test_spilled_sharded_mesh_size_invariance():
 def test_spilled_sharded_store_states_rejected():
     with pytest.raises(NotImplementedError, match="archive"):
         SpilledShardedEngine(MICRO, chunk=64, store_states=True)
+
+
+def test_spilled_sharded_host_table_parity():
+    """Host-partitioned table composed with mesh dedup (ISSUE 1): each
+    device's authoritative visited set moves to a per-device
+    prefix-partitioned host table while hash-ownership keeps routing
+    keys — with dev_keys squeezed far below the distinct count so the
+    per-device caches reseed and the host sweep is what drops
+    old-level keys, counts must equal the un-composed engine's
+    bit-identically."""
+    want = explore(MICRO)
+    base = SpilledShardedEngine(MICRO, chunk=64, lcap=8 * 192,
+                                vcap=1 << 13)
+    ref = base.check()
+    eng = SpilledShardedEngine(MICRO, chunk=64, lcap=8 * 192,
+                               vcap=1 << 13, host_table=True,
+                               partitions=4, part_cap=1 << 6,
+                               dev_keys=32)
+    got = eng.check()
+    assert got.distinct_states == want.distinct_states
+    assert got.depth == want.depth
+    assert got.generated_states == want.generated_states
+    assert got.level_sizes == want.level_sizes
+    assert (got.distinct_states, got.level_sizes) == \
+        (ref.distinct_states, ref.level_sizes)
+    # the per-device host tables jointly hold every distinct key, and
+    # ownership keeps them disjoint
+    assert sum(t.n_keys for t in eng.hpts) == want.distinct_states
+    want_viol = Counter(v.invariant for v in want.violations)
+    got_viol = Counter(v.invariant for v in got.violations)
+    assert got_viol == want_viol

@@ -12,7 +12,15 @@ instead of the level buffers.
 Usage: python tools/deep_run.py CONFIG DEPTH [--fp128] [--chunk N]
        [--seg N] [--vcap N] [--tag NAME] [--classic] [--lcap N]
        [--fcap N] [--native] [--budget N] [--ckpt FILE]
-       [--resume FILE] [--ckpt-every N]
+       [--resume FILE] [--ckpt-every N] [--host-table]
+       [--partitions P] [--part-cap N]
+
+--host-table moves the visited set to fingerprint-prefix partitions in
+host RAM (engine/host_table), streamed through HBM per level — the
+depth wall becomes host RAM instead of the ~2^29-slot HBM table.
+Checkpoints then carry the partition images (sparse, exact-image
+restore) and --resume must repeat the same --host-table/--partitions;
+the engine refuses a mismatched resume rather than drift.
 
 --classic uses the in-HBM Engine instead of SpillEngine (for
 depth-exact head-to-heads at depths that still fit); --native also
@@ -51,14 +59,20 @@ def main():
     args = sys.argv[1:]
     conf_no = int(args.pop(0))
     depth = int(args.pop(0))
-    flags = {f: f in args for f in ("--fp128", "--classic", "--native")}
+    flags = {f: f in args for f in ("--fp128", "--classic", "--native",
+                                    "--host-table")}
     for f, on in flags.items():
         if on:
             args.remove(f)
     fp128 = flags["--fp128"]
+    host_table = flags["--host-table"]
+    if host_table and flags["--classic"]:
+        raise SystemExit("--host-table composes with the spill engine; "
+                         "drop --classic")
     opts = dict(zip(args[::2], args[1::2]))
     known = {"--chunk", "--seg", "--vcap", "--budget", "--tag", "--lcap",
-             "--fcap", "--ckpt", "--resume", "--ckpt-every"}
+             "--fcap", "--ckpt", "--resume", "--ckpt-every",
+             "--partitions", "--part-cap"}
     bad = set(opts) - known
     if bad or len(args) % 2:
         # fail loud: these depths cannot be cross-checked by any other
@@ -71,9 +85,12 @@ def main():
     seg = int(opts.get("--seg", 1 << 22))
     vcap = int(opts.get("--vcap", 1 << 26))
     budget = int(opts.get("--budget", 10 ** 9))
+    partitions = int(opts.get("--partitions", 4))
+    part_cap = int(opts.get("--part-cap", 1 << 16))
     tag = opts.get("--tag",
                    f"config{conf_no}_depth{depth}"
-                   + ("_fp128" if fp128 else ""))
+                   + ("_fp128" if fp128 else "")
+                   + ("_hosttable" if host_table else ""))
 
     cfg = build_cfg(conf_no)
     if fp128:
@@ -97,7 +114,8 @@ def main():
                      else None)
     else:
         eng = SpillEngine(cfg, chunk=chunk, store_states=False, seg=seg,
-                          vcap=vcap)
+                          vcap=vcap, host_table=host_table,
+                          partitions=partitions, part_cap=part_cap)
     t0 = time.time()
     eng.check(max_depth=2)                       # warm the jit caches
     compile_s = time.time() - t0
@@ -138,6 +156,7 @@ def main():
         "violations": len(r.violations),
         "overflow_faults": int(r.overflow_faults),
         "chunk": chunk, "seg": seg, "final_vcap": int(eng.VCAP),
+        "host_table": host_table,
         "resumed_from_checkpoint": bool(resume),
         "expected_fp_collisions": float(
             r.distinct_states ** 2 /
@@ -146,8 +165,15 @@ def main():
     # spill perf floor (VERDICT r4 #6): the canonical spill probe shape
     # (config #2, depth-exact 19, SpillEngine, single session) guards
     # the spill engine's rate the way bench.py guards the classic one
+    if host_table:
+        rec["partitions"] = partitions
+        rec["host_table_keys"] = int(eng.hpt.n_keys)
+        rec["host_table_bytes"] = int(eng.hpt.nbytes)
+    # (host-table runs are rate-recorded but never floor-gate: the
+    # canonical spill probe guards the default in-HBM-table path)
     if (not flags["--classic"] and conf_no == 2 and depth == 19
-            and rec["depth_exact"] and not fp128 and not resume):
+            and rec["depth_exact"] and not fp128 and not resume
+            and not host_table):
         import jax
 
         from bench import perf_floor

@@ -40,6 +40,22 @@ def warm(tag, cfg, **kw):
     del eng
 
 
+def warm_spill(tag, cfg, **kw):
+    """Spill-engine twin of warm(); with host_table=True the depth-2
+    check additionally exercises the partitioned-table executables
+    (the sweep membership probe, the cache-reseed insert, and the
+    lfp-carrying spill slice), so a post-change deep_run/bench with
+    --host-table doesn't pay their cold compiles mid-run."""
+    from raft_tla_tpu.engine.spill import SpillEngine
+    t0 = time.time()
+    eng = SpillEngine(cfg, store_states=False, **kw)
+    eng.check(max_depth=2)
+    print(f"{tag}: warmed in {time.time() - t0:.1f}s "
+          f"(chunk={eng.chunk} SEGL={eng.SEGL} VCAP={eng.VCAP} "
+          f"host_table={eng.host_table})", flush=True)
+    del eng
+
+
 def main():
     from tools.measure_baseline import ENGINE_KW, build_cfg
 
@@ -61,6 +77,14 @@ def main():
         warm("bench micro gate", micro, chunk=256)
         warm("bench headline", build_cfg(2), chunk=2048,
              lcap=bench.LCAP, vcap=bench.VCAP)
+        # deep_run's spill probe shape, host table OFF and ON: the ON
+        # pass compiles the sweep/reseed executables at the ladder's
+        # quantized key-block shapes
+        warm_spill("spill config 2", build_cfg(2), chunk=4096,
+                   seg=1 << 22, vcap=1 << 26)
+        warm_spill("spill config 2 +host-table", build_cfg(2),
+                   chunk=4096, seg=1 << 22, vcap=1 << 26,
+                   host_table=True, partitions=4, part_cap=1 << 16)
     for n in args or [1, 2, 3, 4, 5]:
         warm(f"config {n}", build_cfg(n), **ENGINE_KW[n])
 
