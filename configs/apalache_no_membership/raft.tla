@@ -1,0 +1,17 @@
+---- MODULE raft ----
+\* Bound-constant stub of the reference apalache_no_membership/raft.tla
+\* (see configs/tlc_membership/raft.tla): only the regex-scanned bound
+\* constants and the MaxInFlightMessages formula shape matter to the
+\* cfg front-end (cfg/parser.read_bounds_from_spec /
+\* max_inflight_from_spec).
+
+MaxLogLength == 5
+MaxRestarts == 2
+MaxTimeouts == 2
+MaxClientRequests == 3
+
+MaxInFlightMessages == LET card == 2 * Cardinality(Server) IN card * card
+
+BoundedTrace == Len(globalHistory) <= 12
+
+====

@@ -1,0 +1,19 @@
+---- MODULE raft ----
+\* Bound-constant stub of the reference tlc_membership/raft.tla: the
+\* cfg front-end lifts the in-spec search bounds by regex-scanning the
+\* sibling .tla (cfg/parser.read_bounds_from_spec) — only these
+\* definitions matter to it.  The full Next-relation semantics live in
+\* raft_tla_tpu/models/raft.py (the oracle) and ops/kernels.py (the
+\* device kernels), both cited line-by-line against the reference spec.
+
+MaxLogLength == 5
+MaxRestarts == 2
+MaxTimeouts == 3
+MaxClientRequests == 3
+MaxMembershipChanges == 3
+
+MaxInFlightMessages == LET card == Cardinality(Server) IN 2 * card * card
+
+BoundedTrace == Len(globalHistory) <= 24
+
+====

@@ -57,6 +57,11 @@ def _apply_overrides(cfg, args):
         kw["init_servers"] = tuple(range(args.init_servers))
     if args.symmetry is not None:
         kw["symmetry"] = args.symmetry
+    if getattr(args, "next_family", None):
+        # next-relation family override (the CLI analog of editing the
+        # cfg's NEXT line — e.g. NextDynamic enables the membership
+        # actions the MembershipChange* scenario targets need)
+        kw["next_family"] = args.next_family
     b = cfg.bounds
     bkw = {}
     if args.max_terms is not None:
@@ -305,11 +310,42 @@ def _write_seed(path, obj):
     print(f"seed written to {path}", file=sys.stderr)
 
 
-def cmd_trace(args):
+def _seed_obj(sv, hist, arrs):
+    """Witness end state -> the seed-file object `check --seed-trace`
+    accepts: oracle view (state_to_obj) plus the raw non-VIEW lanes
+    (exact history counters + scenario feature lanes), so a seeded
+    engine resumes with identical constraint / scenario-predicate
+    inputs.  ONE definition — trace and simulate both emit through it,
+    so their seed files cannot drift."""
+    import numpy as np
+    from .models.raft import state_to_obj
+    from .ops.codec import NONVIEW_KEYS
+    obj = state_to_obj(sv, hist)
+    obj["nonview"] = {k: np.asarray(arrs[k]).tolist()
+                      for k in NONVIEW_KEYS}
+    return obj
+
+
+def _check_target(name) -> bool:
+    """Validate a --target against the shared scenario registry
+    (ops/vpredicates.SCENARIO_PROPERTIES — the ONE table trace,
+    simulate and the help text all read, so new sim-reachable targets
+    cannot drift out of the CLI).  Safety invariants are also accepted
+    (hunting a real violation is a legitimate target)."""
     from .models import predicates as OP
-    if args.target not in OP.INVARIANTS:
-        print(f"unknown scenario property {args.target!r}; known: "
-              f"{', '.join(sorted(OP.INVARIANTS))}", file=sys.stderr)
+    from .ops.vpredicates import SCENARIO_PROPERTIES
+    if name in OP.INVARIANTS:
+        return True
+    print(f"unknown scenario property {name!r}; known scenario "
+          f"properties: {', '.join(SCENARIO_PROPERTIES)}\n"
+          f"(safety invariants are accepted too: "
+          f"{', '.join(sorted(set(OP.INVARIANTS) - set(SCENARIO_PROPERTIES)))})",
+          file=sys.stderr)
+    return False
+
+
+def cmd_trace(args):
+    if not _check_target(args.target):
         return 2
     cfg = load_model(args.cfg, bounds=None)
     cfg = _apply_overrides(cfg, args)
@@ -352,18 +388,86 @@ def cmd_trace(args):
         if args.verbose:
             print(f"       {sv}")
     if args.emit_seed:
-        import numpy as np
-        from .models.raft import state_to_obj
-        from .ops.codec import NONVIEW_KEYS, decode
+        from .ops.codec import decode
         arrs = eng.get_state_arrays(v.state_id)
         sv, h = decode(eng.lay, arrs)
-        obj = state_to_obj(sv, h)
-        # raw non-VIEW lanes: exact history counters + scenario feature
-        # lanes, so a seeded engine resumes with identical constraint /
-        # scenario-predicate inputs (the decoded Hist has no glob)
-        obj["nonview"] = {k: np.asarray(arrs[k]).tolist()
-                          for k in NONVIEW_KEYS}
-        _write_seed(args.emit_seed, obj)
+        _write_seed(args.emit_seed, _seed_obj(sv, h, arrs))
+    return 0
+
+
+def cmd_simulate(args):
+    """TLC ``-simulate`` analogue: W vmapped random walkers hunt a
+    scenario property beyond the exhaustive stack's reach (sim/walker
+    design notes).  Exit 0 on a witness, 1 on none within the step
+    budget."""
+    import time
+    if not _check_target(args.target):
+        return 2
+    cfg = load_model(args.cfg, bounds=None)
+    cfg = _apply_overrides(cfg, args)
+    cfg = cfg.with_(invariants=(args.target,))
+    # --max-depth doubles as the walk restart bound; the check-style
+    # "unbounded" default maps to a walk-sized one
+    depth = args.max_depth if args.max_depth < 10 ** 6 else 64
+    import jax
+    from .sim import SimEngine
+    kw = dict(max_depth=depth, seed=args.seed, policy=args.policy,
+              bloom_bits=args.bloom_bits)
+    if args.mesh and len(jax.local_devices()) > 1:
+        from .parallel.sim_mesh import ShardedSimEngine
+        eng = ShardedSimEngine(cfg, walkers=args.walkers, **kw)
+    else:
+        eng = SimEngine(cfg, walkers=args.walkers, **kw)
+    t0 = time.time()
+    r = eng.run(steps=args.steps,
+                steps_per_dispatch=args.steps_per_dispatch,
+                verbose=args.verbose)
+    out = {
+        "target": args.target,
+        "policy": args.policy,
+        "walkers": r.walkers,
+        "steps_dispatched": r.steps_dispatched,
+        "walker_steps": r.walker_steps,
+        "sampled_steps": r.sampled_steps,
+        "walker_steps_per_sec": round(r.walker_steps_per_sec, 1),
+        "restarts": r.restarts,
+        "deadlocks": r.deadlocks,
+        "promotions": r.promotions,
+        "seconds": round(r.seconds, 3),
+        "est_distinct_states": round(r.est_distinct_states, 1),
+        "bloom_saturated": r.bloom_saturated,
+        "bloom_canonical": r.bloom_canonical,
+        "hits": len(r.hits),
+        "platform": jax.default_backend(),
+        "seed": args.seed,
+    }
+    print(json.dumps(out))
+    if args.stats_json:
+        with open(args.stats_json, "w") as fh:
+            json.dump(out, fh)
+    if not r.hits:
+        print(f"no witness found for {args.target} within "
+              f"{r.walker_steps} walker-steps", file=sys.stderr)
+        return 1
+    h = eng.decode_hit(r.hits[0])
+    print(f"witness for {args.target} at depth {h.depth} "
+          f"(walker {h.walker}, {r.walker_steps} walker-steps, "
+          f"{time.time() - t0:.1f}s):")
+    for step, (label, sv) in enumerate(h.trace):
+        print(f"  {step:3d}  {label}")
+        if args.verbose:
+            print(f"       {sv}")
+    if args.trace_out:
+        with open(args.trace_out, "w") as fh:
+            json.dump({"target": args.target, "depth": h.depth,
+                       "walker": h.walker, "seed": args.seed,
+                       "labels": [label for label, _sv in h.trace]},
+                      fh)
+        print(f"witness trace written to {args.trace_out}",
+              file=sys.stderr)
+    if args.emit_seed:
+        _write_seed(args.emit_seed,
+                    _seed_obj(h.trace[-1][1], h.hist, h.state_arrs))
     return 0
 
 
@@ -387,6 +491,13 @@ def main(argv=None):
                         help="override |InitServer| (first K servers)")
         sp.add_argument("--symmetry", action=argparse.BooleanOptionalAction,
                         default=None)
+        sp.add_argument("--next", dest="next_family", default=None,
+                        choices=("NextAsync", "NextAsyncCrash", "Next",
+                                 "NextDynamic"),
+                        help="override the cfg's NEXT family (e.g. "
+                             "NextDynamic enables the membership "
+                             "actions the MembershipChange* scenario "
+                             "targets need)")
         sp.add_argument("--max-terms", type=int, default=None)
         sp.add_argument("--max-log-length", type=int, default=None)
         sp.add_argument("--max-timeouts", type=int, default=None)
@@ -464,15 +575,61 @@ def main(argv=None):
                     help="enable an extra ACTION_CONSTRAINT (repeatable)")
     pc.set_defaults(fn=cmd_check)
 
+    # --target help comes from the ONE scenario registry
+    # (ops/vpredicates.SCENARIO_PROPERTIES) so new sim-reachable
+    # targets cannot drift out of the help text
+    from .ops.vpredicates import SCENARIO_PROPERTIES
+    target_help = ("scenario property name: " +
+                   ", ".join(SCENARIO_PROPERTIES))
+
     pt = sub.add_parser("trace", help="generate a scenario witness trace")
     common(pt)
-    pt.add_argument("--target", required=True,
-                    help="scenario property name (e.g. FirstCommit, "
-                         "ConcurrentLeaders, MembershipChangeCommits)")
+    pt.add_argument("--target", required=True, help=target_help)
     pt.add_argument("--emit-seed", default=None, metavar="FILE",
                     help="write the witness end state to FILE as a seed "
                          "for `check --seed-trace` (punctuated search)")
     pt.set_defaults(fn=cmd_trace)
+
+    ps = sub.add_parser(
+        "simulate",
+        help="random-walk scenario hunt (TLC -simulate analogue): W "
+             "vmapped walkers sample enabled actions uniformly — for "
+             "configs beyond the exhaustive stack's reach")
+    common(ps)
+    ps.add_argument("--target", required=True, help=target_help)
+    ps.add_argument("--walkers", type=int, default=256,
+                    help="fleet width W (one vmapped lane per walker)")
+    ps.add_argument("--steps", type=int, default=10000,
+                    help="synchronous fleet steps before giving up")
+    ps.add_argument("--steps-per-dispatch", type=int, default=256,
+                    help="walker steps fused into one device program "
+                         "(the persistent-kernel loop length)")
+    ps.add_argument("--seed", type=int, default=0,
+                    help="PRNG seed; fixed seeds replay bit-identical "
+                         "trajectories across runs and --walkers "
+                         "shardings")
+    ps.add_argument("--policy", choices=("punctuated", "tlc"),
+                    default="punctuated",
+                    help="restart policy: 'punctuated' (default) "
+                         "resamples pruned successors and restarts "
+                         "from per-walker scenario-ladder bases; "
+                         "'tlc' is exact TLC -simulate shape (abandon "
+                         "the walk on any pruned successor)")
+    ps.add_argument("--bloom-bits", type=int, default=24,
+                    help="log2 bits of the novelty Bloom filter behind "
+                         "est_distinct_states")
+    ps.add_argument("--mesh", action="store_true",
+                    help="shard the fleet across all local devices "
+                         "(pmapped per-device cohorts)")
+    ps.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="write the witness trace (labels) as JSON")
+    ps.add_argument("--emit-seed", default=None, metavar="FILE",
+                    help="write the witness end state as a seed for "
+                         "`check --seed-trace` (simulation feeds "
+                         "punctuated exhaustive search)")
+    ps.add_argument("--stats-json", default=None, metavar="FILE",
+                    help="write the run stats JSON to FILE")
+    ps.set_defaults(fn=cmd_simulate)
 
     args = p.parse_args(argv)
     _honor_platform_env()

@@ -306,6 +306,39 @@ class Expander:
         h_all = jnp.concatenate(fp_outs, axis=-1)[..., take]
         return cand, counts, delta_fp[0].finish_min(h_all)
 
+    # ---- per-walker step fusion (the sim engine's hot path) --------------
+    #
+    # A random walker takes ONE lane per state per step, so the full
+    # [B, A] candidate materialization (or even the FCAP compaction) is
+    # ~A× too much successor construction.  step_lanes instead applies
+    # each family's kernel ONCE per walker with that walker's chosen
+    # params (clipped to the family's grid when the walker chose another
+    # family — the result is discarded by the select), then merges the
+    # n_families results by lane-range selects.  Cost per step is
+    # n_families (~10-14) kernel applications per walker versus
+    # A (~90-370) lanes of a full expansion; the guard pass stays the
+    # dead-code-eliminated guards_T grid.
+
+    def step_lanes(self, svT, derT, lane) -> Dict[str, jnp.ndarray]:
+        """Batch-last walker states [..., B] + flat lane ids [B] ->
+        successor rows [..., B].  lane must be an enabled lane of its
+        state (sim samples from guards_T via ops.kernels.select_enabled);
+        rows whose lane is out of range (e.g. -1 = no enabled lane)
+        return the state unchanged — callers mask on enabled-count."""
+        out = {k: v for k, v in svT.items()}
+        off = 0
+        for fam in self.families:
+            nf = fam.n_lanes
+            li = jnp.clip(lane - off, 0, nf - 1)
+            prm = [jnp.asarray(p)[li] for p in fam.params]
+            _ok, sv2 = jax.vmap(
+                fam.fn, in_axes=(-1, -1) + (0,) * len(fam.params),
+                out_axes=(0, -1))(svT, derT, *prm)
+            sel = (lane >= off) & (lane < off + nf)
+            out = {k: jnp.where(sel, sv2[k], out[k]) for k in out}
+            off += nf
+        return out
+
     # ---- test/debug path -------------------------------------------------
     def expand_one(self, arrs: Dict[str, np.ndarray]):
         """Single state -> [(label, sv2_arrays)] for enabled lanes."""

@@ -616,6 +616,40 @@ class Fingerprinter:
         return self._seal(self._lex_min(h_all))
 
 
+# ---------------------------------------------------------------------------
+# Best-effort novelty Bloom filter (sim/walker.py): the random-walk
+# engine cannot afford an authoritative visited set (walkers revisit
+# states by design), but a Bloom filter over the SAME symmetry-canonical
+# fingerprints the exhaustive engines dedup on gives an estimated
+# distinct-state coverage for ~1 bit/slot.  The k probe positions come
+# straight from the fingerprint's independent u32 streams (remixed when
+# k exceeds the stream count), so sim and BFS agree on state identity.
+# ---------------------------------------------------------------------------
+
+def bloom_positions(fp, m_bits: int, k: int = 2) -> jnp.ndarray:
+    """Canonical fingerprints [n_streams, B] u32 -> [k, B] int32 bit
+    positions into a 2^m_bits Bloom array."""
+    T = fp.shape[0]
+    out = []
+    for j in range(k):
+        h = fp[j % T]
+        if j >= T:            # remix re-used streams with a round salt
+            h = fmix32(h ^ U32((0x9E3779B9 * (j // T)) & 0xFFFFFFFF))
+        out.append((h & U32((1 << m_bits) - 1)).astype(jnp.int32))
+    return jnp.stack(out)
+
+
+def bloom_estimate(bits_set: int, m_bits: int, k: int = 2) -> float:
+    """Standard Bloom cardinality estimate n̂ = -(m/k)·ln(1 - X/m).
+    A saturated filter (X == m) clamps to X = m-1, i.e. (m/k)·ln m —
+    an arbitrary ceiling, not an estimate; callers must surface the
+    saturation flag (SimResult.bloom_saturated) instead of trusting
+    the number there."""
+    m = float(1 << m_bits)
+    x = float(min(bits_set, (1 << m_bits) - 1))
+    return -(m / k) * float(np.log1p(-x / m))
+
+
 # canonical dedup-key bit layout lives in utils (host helpers);
 # re-exported here for back-compat with older imports
 from ..utils import combine_u64  # noqa: E402,F401

@@ -255,6 +255,164 @@ def explore(cfg: ModelConfig, max_depth: int = 10 ** 9,
     return result
 
 
+# ---------------------------------------------------------------------------
+# Random-walk twin (TLC -simulate; oracle of sim/walker.SimEngine)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class WalkResult:
+    steps: int                    # transitions actually taken
+    restarts: int
+    deadlocks: int
+    sampled: int = 0              # successors drawn (incl. pruned
+                                  # redraws — the engine's sampled_steps)
+    hits: List[Violation] = field(default_factory=list)
+    # labels of the walk that hit (root -> witness end), if any
+    hit_trace: Optional[List[str]] = None
+    hit_state: Optional[State] = None
+    hit_hist: Optional[Hist] = None
+    distinct_states: int = 0      # exact (set-based) distinct visited
+
+
+def random_walk(cfg: ModelConfig, steps: int, max_depth: int = 64,
+                seed: int = 0, stop_on_hit: bool = True,
+                resample_pruned: bool = False) -> WalkResult:
+    """Plain-Python uniform random walk — the executable oracle of the
+    TPU sim engine (sim/walker.py) and of TLC's ``-simulate`` mode:
+
+      * uniform choice over the enabled successor transitions of the
+        current state (the same surface the engine's enabled-lane
+        sampling draws from — tests/test_sim.py pins the per-step
+        enabled COUNTS against the engine's lane grid);
+      * CONSTRAINT semantics prune-not-reject: a violating successor is
+        invariant-checked but never extended — the walk restarts from
+        the root (``resample_pruned=False``, TLC parity) or redraws
+        uniformly among the remaining enabled successors
+        (``resample_pruned=True``, the engine's 'punctuated' prune
+        handling: rejection sampling = uniform over the extendable
+        subset);
+      * bounded-depth restart at ``max_depth``; deadlock restarts.
+
+    The RNG streams are NOT shared with the engine (python Random vs
+    jax.random) — differential tests replay the ENGINE's recorded
+    choices through the oracle transition relation instead
+    (oracle_validates_walk)."""
+    import random as _random
+    rng = _random.Random(seed)
+    inv_fns = [(nm, predicates.resolve_invariant(nm, cfg))
+               for nm in cfg.invariants]
+    con_fns = [predicates.CONSTRAINTS[nm] for nm in cfg.constraints]
+    root = init_state(cfg)
+    sv, h = root
+    depth = 0
+    labels: List[str] = []
+    res = WalkResult(steps=0, restarts=0, deadlocks=0)
+    seen = {_walk_key(root[0])}
+    # depth-0 check: the engine checks the root once up front too
+    for nm, fn in inv_fns:
+        if not fn(root[0], root[1], cfg):
+            res.hits.append(Violation(nm, root[0], root[1]))
+            if res.hit_trace is None:
+                res.hit_trace = []
+                res.hit_state, res.hit_hist = root
+    if res.hits and stop_on_hit:
+        return _walk_finish(res, seen)
+    for _ in range(steps):
+        succ = walk_enabled(sv, h, cfg)      # the ONE sampling surface
+        if not succ:
+            res.deadlocks += 1
+            res.restarts += 1
+            sv, h = root
+            depth = 0
+            labels = []
+            continue
+        remaining = list(succ)
+
+        def check(sv2, h2):
+            ok = True
+            for nm, fn in inv_fns:
+                if not fn(sv2, h2, cfg):
+                    res.hits.append(Violation(nm, sv2, h2))
+                    if res.hit_trace is None:
+                        res.hit_trace = list(labels)
+                        res.hit_state, res.hit_hist = sv2, h2
+                    ok = False
+            return ok
+
+        pruned_out = False
+        while True:
+            k = rng.randrange(len(remaining))
+            label, sv2, h2 = remaining.pop(k)
+            res.sampled += 1
+            seen.add(_walk_key(sv2))
+            labels.append(label)
+            hit = not check(sv2, h2)
+            if hit and stop_on_hit:
+                return _walk_finish(res, seen)
+            if all(f(sv2, h2, cfg) for f in con_fns):
+                res.steps += 1           # accepted transition
+                break
+            labels.pop()
+            if not resample_pruned or not remaining:
+                pruned_out = True
+                break
+        depth += 1
+        if pruned_out or depth >= max_depth:
+            res.restarts += 1
+            sv, h = root
+            depth = 0
+            labels = []
+        else:
+            sv, h = sv2, h2
+    return _walk_finish(res, seen)
+
+
+def _walk_finish(res: "WalkResult", seen) -> "WalkResult":
+    res.distinct_states = len(seen)
+    return res
+
+
+def _walk_key(sv: State):
+    return sv._replace(msgs=tuple(sorted(sv.msgs)))
+
+
+def walk_enabled(sv: State, h: Hist, cfg: ModelConfig):
+    """The enabled successor transitions the walk samples from (action
+    constraints applied — the sampling surface)."""
+    succ = successors(sv, h, cfg)
+    act_fns = [predicates.ACTION_CONSTRAINTS[nm]
+               for nm in cfg.action_constraints]
+    if act_fns:
+        succ = [(lb, s2, h2) for (lb, s2, h2) in succ
+                if all(f(sv, h, s2, h2, cfg) for f in act_fns)]
+    return succ
+
+
+def oracle_validates_walk(cfg: ModelConfig, states: List[State]
+                          ) -> List[str]:
+    """Replay an engine-decoded state chain through the oracle
+    transition relation: every consecutive pair must be one oracle
+    transition (state equality modulo message-bag order — slot order is
+    not part of state identity, ops/layout.py).  Returns the oracle's
+    labels for the walk; raises ValueError at the first step the oracle
+    cannot take.  This is the 'oracle replays it as a valid behavior'
+    check the sim witness traces are accepted under."""
+    sv, h = init_state(cfg)
+    if _walk_key(states[0]) != _walk_key(sv):
+        raise ValueError("walk does not start at Init")
+    out: List[str] = []
+    for t, nxt in enumerate(states[1:]):
+        want = _walk_key(nxt)
+        matches = [(lb, s2, h2) for (lb, s2, h2) in successors(sv, h, cfg)
+                   if _walk_key(s2) == want]
+        if not matches:
+            raise ValueError(
+                f"step {t + 1}: engine state is not an oracle successor")
+        lb, sv, h = matches[0]
+        out.append(lb)
+    return out
+
+
 def _trace_to(k, parent) -> List[str]:
     out = []
     while True:

@@ -49,6 +49,22 @@ def popcount(x, nbits):
     return total
 
 
+def select_enabled(ok, u):
+    """The ``u``-th enabled lane of a flat guard mask (0-based), or -1
+    when no lane is enabled.
+
+    This is the random-walk engine's sampling kernel (sim/walker.py):
+    ``ok`` is one state's [A] enabling-guard vector over the expander's
+    lane grid and ``u`` a uniform draw in [0, sum(ok)), so picking the
+    u-th set bit IS the uniform choice over enabled (action, server,
+    param) lanes — the same successor surface TLC's ``-simulate`` mode
+    samples uniformly.  One cumsum + argmax, no data-dependent shapes,
+    so it vmaps over walker fleets."""
+    csum = jnp.cumsum(ok.astype(jnp.int32))
+    idx = jnp.argmax(csum > u).astype(jnp.int32)
+    return jnp.where(csum[-1] > 0, idx, jnp.int32(-1))
+
+
 class RaftKernels:
     """Kernel family bound to one (Layout, ModelConfig)."""
 

@@ -367,6 +367,37 @@ INVARIANTS: Dict[str, Callable] = {
         Predicates.leader_changes_during_conf_change,
 }
 
+# The scenario ("Test cases") properties of raft.cfg:51-76 — negated
+# reachability targets, the subset of INVARIANTS whose "violation" is a
+# wanted witness rather than a bug.  This is the ONE registry the CLI
+# surfaces (`trace`/`simulate` --target help + validation) and the sim
+# engine samples toward: a predicate added here is automatically
+# advertised and targetable, so the help text cannot drift from the
+# implementation (it used to be a hand-kept string).
+SCENARIO_PROPERTIES = (
+    "BoundedTrace",
+    "FirstBecomeLeader",
+    "FirstCommit",
+    "FirstRestart",
+    "LeadershipChange",
+    "MembershipChange",
+    "MultipleMembershipChanges",
+    "ConcurrentLeaders",
+    "EntryCommitted",
+    "CommitWhenConcurrentLeaders",
+    "MajorityOfClusterRestarts",
+    "AddSucessful",
+    "MembershipChangeCommits",
+    "MultipleMembershipChangesCommit",
+    "AddCommits",
+    "NewlyJoinedBecomeLeader",
+    "LeaderChangesDuringConfChange",
+)
+
+for _nm in SCENARIO_PROPERTIES:
+    assert _nm in INVARIANTS, \
+        f"scenario property {_nm!r} has no device predicate"
+
 CONSTRAINTS: Dict[str, Callable] = {
     "BoundedInFlightMessages": Predicates.bounded_in_flight_messages,
     "BoundedRequestVote": Predicates.bounded_request_vote,

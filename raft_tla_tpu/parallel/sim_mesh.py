@@ -1,0 +1,111 @@
+"""Pmapped random-walker fleets: sim/walker.SimEngine across the mesh.
+
+Walkers are embarrassingly parallel — no frontier exchange, no dedup
+routing — so the mesh story is a plain ``jax.pmap`` of the single-device
+dispatch program (one persistent ``lax.while_loop`` per device) with
+periodic host-side stats reduction between dispatches:
+
+- the fleet of W walkers splits evenly into D per-device cohorts;
+  walker GLOBAL ids (d * W/D + i) key the ``jax.random`` streams, so a
+  fixed seed replays bit-identical trajectories regardless of the mesh
+  shape (the single-device engine with the same W produces the same
+  walks — tests/test_sim.py pins this);
+- each device keeps its own novelty Bloom filter; the host ORs them at
+  harvest (Bloom union is exact for membership, so the estimated
+  distinct coverage is computed over the union);
+- per dispatch the host syncs one [D, ST_LEN] stats matrix and the hit
+  flags; any device's hit ends the fleet (its while_loop exits early,
+  the others complete their dispatch quota).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..sim.walker import (ST_HIT, ST_ITERS, ST_STEPS, SimEngine,
+                          SimResult)
+
+
+class ShardedSimEngine:
+    """D-device walker fleet.  ``walkers`` is the MESH-TOTAL fleet
+    width, split evenly across devices (must divide)."""
+
+    def __init__(self, cfg: ModelConfig, walkers: int = 1024,
+                 devices: Optional[List] = None, **kw):
+        self.devices = list(devices) if devices else jax.local_devices()
+        self.D = len(self.devices)
+        if walkers % self.D:
+            raise ValueError(
+                f"walkers={walkers} must divide across {self.D} devices")
+        self.Wd = walkers // self.D
+        self.W = walkers
+        self.sim = SimEngine(cfg, walkers=self.Wd, **kw)
+        self._pdisp = jax.pmap(self.sim._dispatch_impl,
+                               static_broadcasted_argnums=(1, 2),
+                               devices=self.devices)
+
+    def fresh_carry(self) -> Dict:
+        carries = []
+        for d in range(self.D):
+            self.sim.wid_base = d * self.Wd
+            carries.append(self.sim.fresh_carry())
+        self.sim.wid_base = 0
+        return jax.device_put_sharded(
+            [jax.tree_util.tree_map(np.asarray, c) for c in carries],
+            self.devices)
+
+    def run(self, steps: int, steps_per_dispatch: int = 256,
+            stop_on_hit: bool = True, verbose: bool = False) -> SimResult:
+        t0 = time.time()
+        root_hit = self.sim._check_root()
+        if root_hit is not None and stop_on_hit:
+            res = self._harvest(self.fresh_carry(), time.time() - t0)
+            res.hits.insert(0, root_hit)
+            return res
+        st = self.fresh_carry()
+        done = 0
+        while done < steps:
+            k = min(steps_per_dispatch, steps - done)
+            st = self._pdisp(st, int(k), bool(stop_on_hit))
+            stats = np.asarray(st["stats"])       # [D, ST_LEN]
+            done = int(stats[:, ST_ITERS].max())
+            if verbose:
+                print(f"fleet: {done} iters, "
+                      f"{int(stats[:, ST_STEPS].sum())} walker-steps "
+                      f"across {self.D} devices", flush=True)
+            if stop_on_hit and stats[:, ST_HIT].any():
+                break
+        res = self._harvest(st, time.time() - t0)
+        if root_hit is not None:
+            res.hits.insert(0, root_hit)
+        return res
+
+    def _harvest(self, st: Dict, seconds: float) -> SimResult:
+        """Shared stats/hit assembly (sim/walker build_result +
+        harvest_hits) over the [D, ...] device axis; the Bloom union is
+        exact for membership, so the coverage estimate covers the whole
+        fleet."""
+        stats = np.asarray(st["stats"])           # [D, ST_LEN]
+        bloom = np.asarray(st["bloom"])           # [D, M]
+        union_bits = int(bloom.any(axis=0).sum())
+        res = self.sim.build_result(stats, union_bits, self.W, seconds)
+        hit = np.asarray(st["hit"])               # [D, Wd]
+        if hit.any():
+            traj = np.asarray(st["traj"])         # [D, R, Wd]
+            hdep = np.asarray(st["hit_depth"])
+            hinv = np.asarray(st["hit_inv"])
+            for d in range(self.D):
+                if hit[d].any():
+                    self.sim.harvest_hits(res, hit[d], traj[d],
+                                          hdep[d], hinv[d],
+                                          d * self.Wd)
+        return res
+
+    def decode_hit(self, h: WalkerHit) -> WalkerHit:
+        return self.sim.decode_hit(h)

@@ -71,14 +71,20 @@ class SpilledShardedEngine(ShardedEngine):
     def __init__(self, cfg: ModelConfig, devices=None, chunk: int = 512,
                  store_states: bool = False, host_table: bool = False,
                  partitions: int = 4, part_cap: int = 1 << 12,
-                 dev_keys: Optional[int] = None, **kw):
-        if store_states:
-            raise NotImplementedError(
-                "SpilledShardedEngine does not archive states yet — "
-                "run ShardedEngine (store_states) within its depth "
-                "range, or SpillEngine single-device")
+                 dev_keys: Optional[int] = None,
+                 archive_dir: Optional[str] = None, **kw):
+        # the parent engines' store machinery is bypassed (this check()
+        # owns level assembly), so init with store OFF and compose the
+        # trace archive from the spilled blocks instead (ROADMAP open
+        # item: mesh-scale witnesses): every harvested block appends a
+        # part in gid order, flushed per level into engine/archive
+        # memmaps (archive_dir) or the in-RAM lists — Engine.trace /
+        # get_state walk either backing unchanged.
         super().__init__(cfg, devices=devices, chunk=chunk,
                          store_states=False, **kw)
+        self.store_states = bool(store_states)
+        self.archive_dir = archive_dir
+        self._cur_parts: List[dict] = []
         # host-partitioned visited table, mesh composition
         # (engine/host_table): hash-ownership routes a key to its owner
         # device (fingerprint stream W-1 mod D) exactly as before, and
@@ -290,6 +296,8 @@ class SpilledShardedEngine(ShardedEngine):
         t0 = time.time()
         lay = self.lay
         D, W = self.D, self.W
+        self._init_store()
+        self._cur_parts = []
 
         # ---- roots: hash-owner placement into host blocks -----------
         roots, rk, pin_interiors = self._dedup_roots(seed_states)
@@ -368,6 +376,13 @@ class SpilledShardedEngine(ShardedEngine):
                 if n_states >= 2 ** 31 - 1:
                     raise RuntimeError(
                         "state-id space exhausted (2^31 ids)")
+                if self.store_states:
+                    # archive part in gid order (this loop assigns gids
+                    # device-major per harvest event, so appending here
+                    # keeps the archive's row order == gid order)
+                    self._cur_parts.append(dict(
+                        n=n, lpar=blk["lpar"], llane=blk["llane"],
+                        rows_major=blk["rows"]))
                 con = blk["lcon"].astype(bool)
                 if con.all():
                     out[d] = (blk["rows"], gids, blk.get("lkey"))
@@ -383,6 +398,7 @@ class SpilledShardedEngine(ShardedEngine):
         frontier: List[List] = [[] for _ in range(D)]
         frontier_keys: List[List] = [[] for _ in range(D)]
         root_front = harvest_blocks(root_blks)
+        self._flush_level_parts()
         for d in range(D):
             if root_front[d] is not None:
                 rows_r, gids_r, fk_r = root_front[d]
@@ -473,6 +489,7 @@ class SpilledShardedEngine(ShardedEngine):
                             rows_b, gids_b, fk_b = outs[d]
                             next_frontier[d].append((rows_b, gids_b))
                             next_keys[d].append(fk_b)
+            self._flush_level_parts()
             res.generated_states += level_gen
             if level_new == 0 and level_gen == 0:
                 depth -= 1
@@ -497,6 +514,32 @@ class SpilledShardedEngine(ShardedEngine):
         res.depth = depth
         res.seconds = time.time() - t0
         return res
+
+    # -- trace-archive composition ------------------------------------
+
+    def _flush_level_parts(self):
+        """One finished level's harvested blocks -> the trace archive
+        (engine/archive memmaps under archive_dir, else the in-RAM
+        lists).  Row order within the level is exactly gid order, so
+        the inherited Engine.trace / get_state_arrays walk works
+        unchanged; a level that archived nothing appends nothing (the
+        archives' gid->row mapping is cumulative, not per-level)."""
+        if not self.store_states:
+            return
+        parts, self._cur_parts = self._cur_parts, []
+        if not parts:
+            return
+        if self._arch is not None:
+            self._arch.append_level_parts(parts)
+            return
+        self._parents.append(np.concatenate(
+            [p["lpar"][:p["n"]] for p in parts]))
+        self._lanes.append(np.concatenate(
+            [p["llane"][:p["n"]] for p in parts]))
+        keys = parts[0]["rows_major"].keys()
+        self._states.append(
+            {k: np.concatenate([p["rows_major"][k][:p["n"]]
+                                for p in parts]) for k in keys})
 
     # -- host-partitioned table composition ---------------------------
 

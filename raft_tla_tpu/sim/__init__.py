@@ -1,0 +1,8 @@
+"""Random-walk simulation engine (TLC ``-simulate`` analogue).
+
+``SimEngine`` runs W vmapped walkers on one device; the pmapped fleet
+lives in parallel/sim_mesh.ShardedSimEngine.  See sim/walker.py for the
+design notes.
+"""
+
+from .walker import SimEngine, SimResult, WalkerHit  # noqa: F401

@@ -18,3 +18,19 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ref_or_local(path: str) -> str:
+    """A reference model path (/root/reference/...), falling back to
+    the repo-local twin under configs/ when the reference tree is not
+    shipped in this container (tests/test_sim.py pins that the twin
+    parses identically).  Tests needing the FULL reference spec text
+    (e.g. TLC emit vendoring) should skip instead — the twins carry
+    only the cfg + the bound-constant stub the parser scans."""
+    if os.path.exists(path):
+        return path
+    local = os.path.join(_REPO, "configs",
+                         os.path.relpath(path, "/root/reference"))
+    return local if os.path.exists(local) else path

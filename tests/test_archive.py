@@ -33,6 +33,7 @@ def _mk_level(rng, n, with_matrix=True):
     return parents, lanes, states
 
 
+@pytest.mark.smoke
 def test_disk_archive_roundtrip_batch_major(tmp_path):
     rng = np.random.default_rng(5)
     arch = DiskArchive(str(tmp_path / "run"))
@@ -56,6 +57,7 @@ def test_disk_archive_roundtrip_batch_major(tmp_path):
     np.testing.assert_array_equal(row["ct"], levels[1][2]["ct"][2])
 
 
+@pytest.mark.smoke
 def test_disk_archive_parts_stream_batch_last(tmp_path):
     """Spill parts arrive batch-LAST (the device block layout) and may
     be over-allocated past n; the archive must transpose and trim
@@ -81,6 +83,7 @@ def test_disk_archive_parts_stream_batch_last(tmp_path):
         np.testing.assert_array_equal(arch.states(0)[k], v)
 
 
+@pytest.mark.smoke
 def test_disk_archive_attach_truncate_resume(tmp_path):
     """attach=True reopens a killed run's completed levels; truncate
     drops levels past a checkpoint so the resumed run re-appends them

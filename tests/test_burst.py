@@ -25,6 +25,10 @@ SMALL = ModelConfig(
     constraints=("BoundedTimeouts", "BoundedClientRequests"))
 
 
+# slow-marked (tier-1 budget, PR 2): the burst==driver A/B runs the
+# space twice; the default burst path stays covered by
+# test_burst_finds_violation and the engine micro differentials
+@pytest.mark.slow
 @pytest.mark.parametrize("cfg", [MICRO, SMALL], ids=["micro", "small"])
 def test_burst_matches_per_level_driver(cfg):
     e_on = Engine(cfg, chunk=64, store_states=True, burst=True)
@@ -49,6 +53,7 @@ def test_burst_matches_per_level_driver(cfg):
             np.testing.assert_array_equal(sa[k], sb[k])
 
 
+@pytest.mark.slow
 def test_burst_respects_max_depth_and_budget():
     for md in (1, 3, 7):
         r_on = Engine(MICRO, chunk=64, store_states=False,
@@ -67,6 +72,7 @@ def test_burst_respects_max_depth_and_budget():
     assert r_on.depth == r_off.depth
 
 
+@pytest.mark.slow
 def test_burst_checkpoint_resume(tmp_path):
     full = Engine(MICRO, chunk=64, store_states=True,
                   burst=True).check()

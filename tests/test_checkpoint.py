@@ -18,6 +18,7 @@ MICRO = ModelConfig(
                        max_client_requests=1))
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_identical(tmp_path):
     full = Engine(MICRO, chunk=64, store_states=True).check()
 
@@ -38,6 +39,7 @@ def test_checkpoint_resume_identical(tmp_path):
     assert sum(len(p) for p in e2._parents) == full.distinct_states
 
 
+@pytest.mark.slow
 def test_sharded_checkpoint_resume_identical(tmp_path):
     import jax
 
@@ -78,10 +80,12 @@ def test_checkpoint_config_mismatch(tmp_path):
         other.check(resume_from=ckpt)
 
 
+@pytest.mark.slow
 def test_cli_checkpoint_resume(tmp_path):
     ckpt = str(tmp_path / "cli.ckpt")
     base = [sys.executable, "-m", "raft_tla_tpu", "check",
-            "/root/reference/tlc_membership/raft.cfg",
+            __import__("conftest").ref_or_local(
+                "/root/reference/tlc_membership/raft.cfg"),
             "--servers", "2", "--init-servers", "2",
             "--max-log-length", "1", "--max-timeouts", "1",
             "--max-client-requests", "1", "--chunk", "64",

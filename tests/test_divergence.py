@@ -38,7 +38,9 @@ from raft_tla_tpu.models.raft import init_state, successors
 
 
 def _ap_cfg():
-    cfg = load_model("/root/reference/apalache_no_membership/raft.cfg",
+    from conftest import ref_or_local
+    cfg = load_model(
+        ref_or_local("/root/reference/apalache_no_membership/raft.cfg"),
                      bounds=Bounds.make(max_log_length=2, max_timeouts=3,
                                         max_client_requests=2))
     # concurrent leaders need 3 servers; the shipped Server={1,2}
@@ -56,6 +58,7 @@ def _seed(cfg, labels=CONCURRENT_LEADERS_LABELS):
     return sv, h
 
 
+@pytest.mark.slow
 def test_apalache_false_leader_completeness_found():
     """Oracle and TPU engine, seeded with the ConcurrentLeaders
     witness, find the LeaderCompleteness_false violation at the same

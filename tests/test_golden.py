@@ -22,7 +22,9 @@ from golden import (CONCURRENT_LEADERS_LABELS, CWCL_EXTENSION_LABELS,
 
 CFG3 = ModelConfig(n_servers=3, init_servers=(0, 1, 2), values=(1, 2),
                    next_family=NEXT_ASYNC)
-TLC_CFG = "/root/reference/tlc_membership/raft.cfg"
+from conftest import ref_or_local
+
+TLC_CFG = ref_or_local("/root/reference/tlc_membership/raft.cfg")
 
 
 def apply_label(sv, h, cfg, label):
@@ -111,6 +113,7 @@ def run_cli(*args):
         capture_output=True, text=True, timeout=1200)
 
 
+@pytest.mark.slow
 def test_punctuated_search_cli(tmp_path):
     """End-to-end punctuated search (raft.tla:1198-1210): seed = the
     golden ConcurrentLeaders end state; a seeded check with the CWCL
@@ -205,6 +208,7 @@ def test_prefix_pin_majority_restarts_seed():
     assert len(views) == 6                     # all relabelings distinct
 
 
+@pytest.mark.slow
 def test_no_store_violation_prints_state():
     """Under --no-store the parent chain is gone but the violating
     state itself is decoded at detection time and must still be shown
@@ -222,6 +226,7 @@ def test_no_store_violation_prints_state():
     assert "State(" in r.stdout
 
 
+@pytest.mark.slow
 def test_emit_seed_roundtrip(tmp_path):
     """`trace --emit-seed` writes a seed that `check --seed-trace`
     accepts on both engines (the CLI surface of punctuated search)."""

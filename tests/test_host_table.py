@@ -43,6 +43,7 @@ def _match(r, want):
     assert r.level_sizes == want.level_sizes
 
 
+@pytest.mark.slow
 def test_host_table_partition_count_invariance():
     """P=1 ≡ P=4 ≡ P=8: bit-identical distinct counts and level sizes
     (the partition id is a pure function of the key, so P only changes

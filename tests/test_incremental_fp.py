@@ -87,6 +87,7 @@ def test_identity_micro():
     _assert_identity(MICRO)
 
 
+@pytest.mark.slow
 def test_identity_membership_dynamic():
     """The widest family set: membership actions, catchup, CoC, cfg
     entries in logs AND messages, under the InitServer-fixing
@@ -99,6 +100,7 @@ def test_identity_unreliable_fp128():
     _assert_identity(UNREL.with_(fp128=True), depth=4)
 
 
+@pytest.mark.slow
 def test_counts_match_direct_engine():
     """End-to-end: the incremental engine lands on the oracle's exact
     counts (the direct engine's parity is pinned by the existing

@@ -57,10 +57,12 @@ def test_sharded_micro_exhaustive():
     compare(MICRO, store_states=False)
 
 
+@pytest.mark.slow
 def test_sharded_micro_symmetric():
     compare(MICRO.with_(symmetry=True), store_states=False)
 
 
+@pytest.mark.slow
 def test_sharded_growth_replay():
     """An undersized send window forces an sovf overflow; growth +
     exact replay must keep counts identical.  (Capacities are only
@@ -95,7 +97,9 @@ def test_sharded_reference_cfg_full_constraints():
       82,751 vs the oracle's 82,771 here — the policy, not luck, is
       what the first two assertions pin)."""
     from raft_tla_tpu.cfg.parser import load_model
-    cfg = load_model("/root/reference/tlc_membership/raft.cfg",
+    from conftest import ref_or_local
+    cfg = load_model(
+        ref_or_local("/root/reference/tlc_membership/raft.cfg"),
                      bounds=Bounds.make(max_log_length=1, max_timeouts=1,
                                         max_client_requests=1))
     want = explore(cfg, max_depth=16)
@@ -115,6 +119,7 @@ def test_sharded_reference_cfg_full_constraints():
     assert a.level_sizes == want.level_sizes
 
 
+@pytest.mark.slow
 def test_sharded_trace_mesh_invariant():
     """VERDICT r4 #9: witness PROVENANCE is mesh-invariant, not just
     counts — the canonical survivor key extends to (parent
@@ -136,6 +141,7 @@ def test_sharded_trace_mesh_invariant():
         assert s4 == s8, f"state divergence at {l4}"
 
 
+@pytest.mark.slow
 def test_sharded_violation_and_trace():
     """Scenario property through the sharded engine: find the
     FirstCommit witness and reconstruct its trace across device-major

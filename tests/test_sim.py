@@ -1,0 +1,280 @@
+"""Random-walk simulation engine tests (sim/walker, parallel/sim_mesh).
+
+Determinism: a fixed --seed replays bit-identical trajectories across
+runs and across --walkers shardings (per-walker streams are keyed by
+GLOBAL walker id, never by fleet shape).
+
+Differential: the oracle random-walk twin (models/explore) replays the
+engine's witness step-for-step — every engine transition is an oracle
+transition, and the per-step enabled-lane count (the uniform-sampling
+surface) equals the oracle's successor count.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_DYNAMIC
+from raft_tla_tpu.models.explore import (oracle_validates_walk,
+                                         random_walk, walk_enabled)
+from raft_tla_tpu.sim import SimEngine
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1),
+    symmetry=False, invariants=("FirstBecomeLeader",))
+
+MEMBER = ModelConfig(
+    n_servers=3, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_DYNAMIC, max_inflight_override=6,
+    bounds=Bounds.make(max_log_length=2, max_timeouts=1,
+                       max_client_requests=1, max_membership_changes=1),
+    symmetry=False, invariants=("MembershipChange",))
+
+
+# ---------------------------------------------------------------------------
+# unit layer (smoke: pure host/device helpers, no fleet compiles)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+def test_select_enabled_unit():
+    import jax.numpy as jnp
+    from raft_tla_tpu.ops.kernels import select_enabled
+    ok = jnp.asarray([False, True, False, True, True])
+    assert int(select_enabled(ok, 0)) == 1
+    assert int(select_enabled(ok, 1)) == 3
+    assert int(select_enabled(ok, 2)) == 4
+    assert int(select_enabled(jnp.zeros(5, bool), 0)) == -1
+
+
+@pytest.mark.smoke
+def test_bloom_estimate_monotone():
+    from raft_tla_tpu.engine.fingerprint import bloom_estimate
+    assert bloom_estimate(0, 16) == 0.0
+    a, b = bloom_estimate(100, 16), bloom_estimate(1000, 16)
+    assert 0 < a < b
+    # sparse filters estimate ~bits/k
+    assert abs(a - 100 / 2) / (100 / 2) < 0.01
+
+
+@pytest.mark.smoke
+def test_scenario_registry_shared():
+    """The ONE scenario table (ops/vpredicates) is consistent with both
+    predicate registries and carries the sim-reachable targets."""
+    from raft_tla_tpu.models import predicates as OP
+    from raft_tla_tpu.ops.vpredicates import (INVARIANTS,
+                                              SCENARIO_PROPERTIES)
+    for nm in SCENARIO_PROPERTIES:
+        assert nm in INVARIANTS, nm
+        assert nm in OP.INVARIANTS, nm
+    assert "MembershipChangeCommits" in SCENARIO_PROPERTIES
+
+
+@pytest.mark.smoke
+def test_repo_local_cfg_parses_like_reference():
+    """configs/tlc_membership mirrors the reference parse exactly
+    (tests/test_cfg.py pins the reference file when that tree exists;
+    this repo-local twin is what the CLI runs against here)."""
+    from raft_tla_tpu.cfg.parser import load_model
+    cfg = load_model("configs/tlc_membership/raft.cfg")
+    assert cfg.n_servers == 3 and cfg.init_servers == (0, 1, 2)
+    assert cfg.values == (1, 2) and cfg.symmetry
+    assert len(cfg.constraints) == 12
+    b = cfg.bounds
+    assert (b.max_log_length, b.max_restarts, b.max_timeouts,
+            b.max_client_requests, b.max_terms,
+            b.max_membership_changes, b.max_trace) == (5, 2, 3, 3, 4, 3,
+                                                       24)
+    assert cfg.max_inflight == 18
+
+
+@pytest.mark.smoke
+def test_cli_target_validation_uses_registry(capsys):
+    """trace/simulate --target validation and its error text come from
+    the shared registry, not a hand-kept string."""
+    from raft_tla_tpu.cli import _check_target
+    assert _check_target("MembershipChangeCommits")
+    assert _check_target("ElectionSafety")   # safety hunts stay legal
+    assert not _check_target("NoSuchScenario")
+    err = capsys.readouterr().err
+    assert "MembershipChangeCommits" in err
+    assert "LeaderChangesDuringConfChange" in err
+
+
+@pytest.mark.smoke
+def test_oracle_random_walk_micro():
+    """The plain-Python twin on its own: finds the shallow scenario,
+    and its trace replays as an oracle behavior by construction."""
+    r = random_walk(MICRO, steps=4000, max_depth=16, seed=3,
+                    resample_pruned=True)
+    assert r.hits, "FirstBecomeLeader should be an easy find"
+    assert r.hit_trace and r.hit_trace[-1].startswith("BecomeLeader")
+
+
+# ---------------------------------------------------------------------------
+# engine determinism
+# ---------------------------------------------------------------------------
+
+def _final_carry(eng, steps):
+    st = eng.fresh_carry()
+    return eng._dispatch(st, steps)
+
+
+# determinism runs use a hit-free target set: a hit stops the WHOLE
+# fleet early, so fleets of different widths would truncate at
+# different iteration counts and trajectories could not be compared
+FREE = MICRO.with_(invariants=())
+
+
+def test_sim_fixed_seed_bit_identical():
+    """Same seed, same fleet -> bit-identical trajectories and stats
+    across two fresh runs."""
+    eng = SimEngine(FREE, walkers=8, max_depth=12, seed=7,
+                    bloom_bits=12)
+    a = _final_carry(eng, 40)
+    b = _final_carry(eng, 40)
+    for k in ("traj", "depth", "hit", "hit_depth", "stats"):
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+
+
+def test_sim_sharding_invariant_streams():
+    """Walker w's trajectory depends only on its GLOBAL id: a W=16
+    fleet and a W=8 fleet with wid_base=8 (the mesh shard layout)
+    produce identical walks for walkers 8..15."""
+    full = SimEngine(FREE, walkers=16, max_depth=12, seed=7,
+                     bloom_bits=12)
+    half = SimEngine(FREE, walkers=8, max_depth=12, seed=7,
+                     bloom_bits=12, wid_base=8)
+    a = _final_carry(full, 25)
+    b = _final_carry(half, 25)
+    assert np.array_equal(np.asarray(a["traj"])[:, 8:],
+                          np.asarray(b["traj"]))
+    assert np.array_equal(np.asarray(a["depth"])[8:],
+                          np.asarray(b["depth"]))
+
+
+def test_sim_fleet_matches_single_device():
+    """The pmapped fleet (2 virtual CPU devices) produces exactly the
+    single-device fleet's trajectories — sharding is invisible."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh (conftest)")
+    from raft_tla_tpu.parallel.sim_mesh import ShardedSimEngine
+    single = SimEngine(FREE, walkers=16, max_depth=12, seed=5,
+                       bloom_bits=12)
+    fleet = ShardedSimEngine(FREE, walkers=16,
+                             devices=jax.devices()[:2],
+                             max_depth=12, seed=5, bloom_bits=12)
+    a = _final_carry(single, 25)
+    st = fleet.fresh_carry()
+    b = fleet._pdisp(st, 25, True)
+    traj = np.asarray(b["traj"])            # [D, R, Wd]
+    merged = np.concatenate([traj[d] for d in range(2)], axis=1)
+    assert np.array_equal(np.asarray(a["traj"]), merged)
+
+
+# ---------------------------------------------------------------------------
+# oracle twin: step-for-step agreement + seed handoff
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def member_hit():
+    eng = SimEngine(MEMBER, walkers=16, max_depth=30, seed=1,
+                    bloom_bits=14)
+    r = eng.run(steps=4000, steps_per_dispatch=256)
+    assert r.hits, "MembershipChange walk found no witness"
+    h = eng.decode_hit(r.hits[0])
+    return eng, r, h
+
+
+def test_sim_witness_oracle_step_for_step(member_hit):
+    """Every engine step is an oracle transition (state equality modulo
+    bag-slot order) AND the sampling surfaces agree: per-step engine
+    enabled-lane count == oracle successor count."""
+    eng, _r, h = member_hit
+    states = [sv for _lbl, sv in h.trace]
+    labels = oracle_validates_walk(MEMBER, states)
+    assert len(labels) == h.depth
+    # enabled-count parity along the walk (the uniform-choice surface)
+    from raft_tla_tpu.models.explore import _walk_key
+    from raft_tla_tpu.models.raft import init_state
+    from raft_tla_tpu.ops.codec import decode, encode
+    arrs = {k: np.asarray(v)
+            for k, v in encode(eng.lay, *init_state(MEMBER)).items()}
+    sv, hh = init_state(MEMBER)
+    for lane in h.lanes[:8]:          # prefix is enough; O(A) per step
+        succ = walk_enabled(sv, hh, MEMBER)
+        enabled = eng.expander.expand_one(arrs)
+        assert len(enabled) == len(succ)
+        arrs = [a for (lbl, a) in enabled
+                if lbl == eng.labels[lane]][0]
+        want = _walk_key(decode(eng.lay, arrs)[0])
+        match = [(s2, h2) for _lb, s2, h2 in succ
+                 if _walk_key(s2) == want]
+        assert match, "engine step is not an oracle successor"
+        sv, hh = match[0]
+
+
+def test_sim_seed_feeds_punctuated_check(member_hit, tmp_path):
+    """The emitted --seed-trace file is accepted by check --seed-trace
+    (simulation feeds punctuated exhaustive search) and seeds the
+    engine with EXACT non-VIEW lanes."""
+    eng, _r, h = member_hit
+    from raft_tla_tpu.models.raft import state_to_obj
+    from raft_tla_tpu.ops.codec import NONVIEW_KEYS
+    obj = state_to_obj(h.trace[-1][1], h.hist)
+    obj["nonview"] = {k: np.asarray(h.state_arrs[k]).tolist()
+                      for k in NONVIEW_KEYS}
+    seed_file = tmp_path / "seed.json"
+    seed_file.write_text(json.dumps(obj))
+
+    from raft_tla_tpu.cli import _engine_seed_arrays, _load_seeds
+    _oracle_seeds, raw = _load_seeds(str(seed_file))
+    seeds = _engine_seed_arrays(MEMBER, raw)
+    assert np.array_equal(seeds[0]["ctr"],
+                          np.asarray(h.state_arrs["ctr"]))
+    from raft_tla_tpu.engine.bfs import Engine
+    bfs = Engine(MEMBER.with_(invariants=()), chunk=64)
+    got = bfs.check(max_depth=1, seed_states=seeds)
+    assert got.distinct_states >= 1
+    assert got.generated_states >= got.distinct_states
+
+
+def test_sim_bloom_reports_coverage(member_hit):
+    """The novelty Bloom estimate is positive, finite and bounded by
+    the walker-step count (it can only undercount distinct states)."""
+    _eng, r, _h = member_hit
+    assert 0 < r.est_distinct_states <= r.walker_steps + r.walkers
+    assert not r.bloom_saturated
+
+
+def test_sim_root_violation_reported_at_depth_zero():
+    """A target already violated at Init is reported as a depth-0 hit
+    (the step loop checks successors only; the root gets its own check
+    — parity with check/trace, which report depth-0 violations)."""
+    cfg = MICRO.with_(invariants=("BoundedTrace",),
+                      bounds=Bounds.make(max_log_length=1,
+                                         max_timeouts=1,
+                                         max_client_requests=1,
+                                         max_trace=-1))
+    eng = SimEngine(cfg, walkers=4, max_depth=8, seed=0, bloom_bits=10)
+    r = eng.run(steps=50)
+    assert r.hits and r.hits[0].depth == 0
+    h = eng.decode_hit(r.hits[0])
+    assert [lbl for lbl, _sv in h.trace] == ["Init"]
+    assert random_walk(cfg, steps=10).hit_trace == []
+
+
+def test_sim_tlc_policy_runs():
+    """The TLC-parity policy (no resampling, root restarts) runs and
+    restarts aggressively under the Clean-start constraints."""
+    eng = SimEngine(MICRO, walkers=8, max_depth=12, seed=2,
+                    policy="tlc", bloom_bits=12)
+    r = eng.run(steps=60, steps_per_dispatch=60, stop_on_hit=False)
+    assert r.steps_dispatched == 60
+    assert r.sampled_steps >= r.walker_steps
+    assert r.restarts > 0            # Clean-start prunes abandon walks
+    assert r.promotions == 0         # no progress bases under tlc

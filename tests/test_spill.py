@@ -43,6 +43,7 @@ def test_spill_micro_exhaustive_tiny_segments():
     assert r.dedup_hit_rate > 0
 
 
+@pytest.mark.slow
 def test_spill_matches_classic_engine_and_traces():
     """store_states path: archives merge across spills; trace() must
     reproduce the oracle's witness semantics (constraints + violation
@@ -60,6 +61,7 @@ def test_spill_matches_classic_engine_and_traces():
     assert tr[0][0] == "Init"
 
 
+@pytest.mark.slow
 def test_spill_constraint_pruning_parity():
     """Host-side prune-not-expand: pruned states are counted and
     checked but not expanded — counts match the oracle on a config
@@ -77,6 +79,7 @@ def test_spill_constraint_pruning_parity():
     _match(r, want)
 
 
+@pytest.mark.slow
 def test_spill_fovf_growth_replay():
     """Deliberately-tiny family caps trip fovf; the chunk-local
     grow-and-replay must preserve exact counts."""
@@ -89,6 +92,7 @@ def test_spill_fovf_growth_replay():
     _match(r, want)
 
 
+@pytest.mark.slow
 def test_spill_checkpoint_resume_identical(tmp_path):
     """Interrupt at a mid-run level, resume, land on counts identical
     to an uninterrupted run — the insurance the hours-scale
@@ -120,6 +124,7 @@ def test_spill_checkpoint_resume_identical(tmp_path):
         [lbl for lbl, _s in e_full.trace(gid)]
 
 
+@pytest.mark.slow
 def test_spill_checkpoint_cross_engine_rejected(tmp_path):
     """Spill checkpoints resume only on SpillEngine; classic Engine
     files are rejected symmetrically (distinct wavefront layouts)."""

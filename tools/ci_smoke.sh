@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Sub-minute CPU-only CI gate: runs exactly the `smoke` pytest marker
+# set (pyproject.toml) with the TPU plugin forced off.  Independent of
+# the tier-1 budget — future PRs get a fast red/green signal even when
+# the full differential suite would blow the harness timeout.
+#
+# Usage: tools/ci_smoke.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m smoke \
+    -p no:cacheprovider "$@"

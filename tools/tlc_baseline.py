@@ -43,6 +43,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 REFERENCE = os.environ.get("RAFT_TLA_REFERENCE",
                            "/root/reference/tlc_membership")
+if not os.path.isdir(REFERENCE):
+    # containers without the reference checkout: the repo-local cfg
+    # twin still lets --cfg default/parse work (emit of a full model
+    # dir additionally needs the real spec + vendored libraries and
+    # will fail loudly if attempted against the stub)
+    _local = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "configs", "tlc_membership")
+    if os.path.isdir(_local):
+        REFERENCE = _local
 
 # in-spec bound constants (tlc_membership/raft.tla:22-30) -> Bounds field
 _BOUND_LINES = {
@@ -224,6 +233,21 @@ def main(argv=None):
                                      b.max_client_requests),
             max_membership_changes=b.max_membership_changes))
 
+    java, jar = find_java(), find_tla2tools(args.tla2tools)
+    if not args.emit_only and (not java or not jar):
+        # this image: no Java, zero egress — BASELINE.md documents that
+        # the 50x target awaits a Java-equipped host running this tool.
+        # Skip BEFORE emitting: emit needs the full reference spec +
+        # vendored libraries, which reference-less containers (running
+        # on the configs/ cfg twins) don't have either.
+        print(json.dumps(dict(
+            status="skipped",
+            reason=("no java on PATH" if not java
+                    else "tla2tools.jar not found (set TLA2TOOLS_JAR)"),
+            note="run on a Java-equipped host to record the real TLC "
+                 "baseline the 50x target names (BASELINE.md)")))
+        return 0
+
     out_dir = args.out or tempfile.mkdtemp(prefix="tlc_model_")
     cfg_path = emit_tlc_model(cfg, out_dir,
                               spec_dir=os.path.dirname(os.path.abspath(
@@ -231,18 +255,6 @@ def main(argv=None):
     rec = {"model_dir": out_dir, "cfg": cfg_path}
     if args.emit_only:
         print(json.dumps(dict(rec, status="emitted")))
-        return 0
-
-    java, jar = find_java(), find_tla2tools(args.tla2tools)
-    if not java or not jar:
-        # this image: no Java, zero egress — BASELINE.md documents that
-        # the 50x target awaits a Java-equipped host running this tool
-        print(json.dumps(dict(
-            rec, status="skipped",
-            reason=("no java on PATH" if not java
-                    else "tla2tools.jar not found (set TLA2TOOLS_JAR)"),
-            note="run on a Java-equipped host to record the real TLC "
-                 "baseline the 50x target names (BASELINE.md)")))
         return 0
 
     tlc = run_tlc(out_dir, workers=args.workers, java=java, jar=jar)
