@@ -78,6 +78,74 @@ def perf_floor(rate, max_depth, plat, floor_path, gate_ok=True,
     return info, status == "hard"
 
 
+def _burst_ab(out_path):
+    """Fused-dispatch A/B (tools/bench_sim.py idiom): the same micro
+    space checked with the multi-level burst ON vs OFF, recording a
+    dispatches-per-level metric — host level-sync round trips per BFS
+    level, counting each burst device call (burst_dispatches counts
+    every call, committing or bailing, as exactly one round trip) plus
+    one per level the per-level driver ran.  This is the
+    dispatch-floor metric the burst exists to cut (ROADMAP open items
+    #3/#4: the tunneled runtime pays ~172 ms per sync).  Counts are
+    correctness-gated: a mismatch labels the file failed.  On this
+    CPU-only container the rows are an honest CPU fallback, exactly as
+    BENCH_r06.json labels the sim figures — the dispatch COUNTS are
+    platform-independent; only the seconds are not."""
+    import jax
+
+    from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+    from raft_tla_tpu.engine.bfs import Engine
+
+    micro = ModelConfig(
+        n_servers=2, init_servers=(0, 1), values=(1,),
+        next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+        bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                           max_client_requests=1))
+    rows, counts = {}, {}
+    for label, burst in (("burst_off", False), ("burst_on", True)):
+        eng = Engine(micro, chunk=256, store_states=False, burst=burst)
+        eng.check(max_depth=2)                   # warm the jit caches
+        t0 = time.time()
+        r = eng.check()
+        secs = time.time() - t0
+        level_syncs = r.burst_dispatches + (r.depth - r.levels_fused)
+        rows[label] = {
+            "distinct_states": int(r.distinct_states),
+            "depth": int(r.depth),
+            "levels_fused": int(r.levels_fused),
+            "burst_dispatches": int(r.burst_dispatches),
+            "burst_bailouts": int(r.burst_bailouts),
+            "level_syncs": int(level_syncs),
+            "dispatches_per_level": round(
+                level_syncs / max(r.depth, 1), 3),
+            "seconds": round(secs, 2),
+            "states_per_sec": round(
+                r.distinct_states / max(secs, 1e-9), 1),
+        }
+        counts[label] = (r.distinct_states, r.depth,
+                         tuple(r.level_sizes))
+    identical = counts["burst_on"] == counts["burst_off"]
+    out = {
+        "bench": "fused multi-level dispatch A/B (bench.py)",
+        "platform": jax.default_backend(),
+        "honest_label": (
+            "CPU-only fallback: this container has no TPU; the "
+            "dispatch/level counts are platform-independent, the "
+            "seconds are XLA:CPU" if jax.default_backend() == "cpu"
+            else "TPU-measured"),
+        "status": ("ok" if identical else
+                   "FAILED: burst counts diverge from the per-level "
+                   "driver — the perf rows are meaningless"),
+        "counts_identical": identical,
+        "rows": rows,
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, out_path)
+    return out
+
+
 def _no_reference_fallback():
     """Containers without the reference checkout (and without the TPU)
     cannot run the headline metric at all — emit ONE honestly-labeled
@@ -133,6 +201,11 @@ def _no_reference_fallback():
                 r.distinct_states / max(secs, 1e-9), 1),
             "counts_match_oracle": bool(ok),
             "perf_floor": floor_info}
+    burst_ab = _burst_ab(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r07.json"))
+    # the burst A/B is correctness-gated like the spill A/B: a
+    # burst≡per-level mismatch fails the shared gate, not just the file
+    gate_ok = gate_ok and burst_ab["counts_identical"]
     print(json.dumps({
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
         "value": None, "unit": "states/sec", "vs_baseline": None,
@@ -140,7 +213,14 @@ def _no_reference_fallback():
                   "are absent on this container; floor rows skip by "
                   "platform_prefix and BENCH_FLOOR.json is unchanged",
         "detail": {"platform": plat, "correctness_gate": bool(gate_ok),
-                   "micro_spill_ab": ab}}))
+                   "micro_spill_ab": ab,
+                   "burst_ab": {
+                       "written_to": "BENCH_r07.json",
+                       "counts_identical":
+                           burst_ab["counts_identical"],
+                       "dispatches_per_level": {
+                           k: v["dispatches_per_level"]
+                           for k, v in burst_ab["rows"].items()}}}}))
 
 
 def main():
@@ -229,6 +309,13 @@ def main():
                 r.depth == nat.depth)
     gate_ok = gate_ok and count_ok
 
+    # fused-dispatch A/B rides along (file only — the stdout contract
+    # stays ONE JSON line); a burst≡per-level mismatch fails the
+    # headline gate and blocks the floor ratchet below
+    burst_ab = _burst_ab(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r07.json"))
+    gate_ok = gate_ok and burst_ab["counts_identical"]
+
     # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------
     # Only meaningful for the full-depth run on the recorded machine
     # class: a shallower --max-depth pays proportionally more per-level
@@ -273,6 +360,8 @@ def main():
                 r.distinct_states ** 2 / 2.0 ** 65),
         },
     }
+    out["detail"]["burst_ab_counts_identical"] = \
+        bool(burst_ab["counts_identical"])
     print(json.dumps(out))
 
 

@@ -204,6 +204,14 @@ def cmd_check(args):
             print("--host-table composes with the spill engine: add "
                   "--spill", file=sys.stderr)
             return 2
+        if args.burst_levels is not None and args.burst_levels <= 0:
+            # a clear error beats the jit-time shape traceback a zero
+            # ring would produce
+            print(f"--burst-levels must be positive (got "
+                  f"{args.burst_levels}); use --no-burst to disable "
+                  "the fused-level path", file=sys.stderr)
+            return 2
+        burst_kw = dict(burst=args.burst, burst_levels=args.burst_levels)
         if args.spill:
             # host-spill engine: levels stream through host RAM, for
             # depths whose level buffers exceed HBM (engine/spill);
@@ -218,11 +226,13 @@ def cmd_check(args):
                               host_table=args.host_table,
                               partitions=args.partitions,
                               part_cap=args.part_cap,
-                              archive_dir=args.archive_dir)
+                              archive_dir=args.archive_dir,
+                              **burst_kw)
         else:
             eng = Engine(cfg, chunk=args.chunk,
                          store_states=not args.no_store,
-                         archive_dir=args.archive_dir)
+                         archive_dir=args.archive_dir,
+                         **burst_kw)
         try:
             r = eng.check(max_depth=args.max_depth,
                           max_states=args.max_states,
@@ -285,7 +295,18 @@ def cmd_check(args):
         out["fp_bits"] = bits
         out["expected_fp_collisions"] = float(
             distinct * distinct / 2.0 ** (bits + 1))
+        # fused-dispatch telemetry: proves the multi-level burst
+        # engaged (levels_fused > 0) instead of silently bailing every
+        # level (burst_bailouts ~ depth with levels_fused 0)
+        out["levels_fused"] = int(r.levels_fused)
+        out["burst_dispatches"] = int(r.burst_dispatches)
+        out["burst_bailouts"] = int(r.burst_bailouts)
     print(json.dumps(out))
+    if args.stats_json:
+        # oracle runs write the same stats file (minus the
+        # fingerprint/burst telemetry keys the oracle has no notion of)
+        with open(args.stats_json, "w") as fh:
+            json.dump(out, fh)
     for k, (name, trace) in enumerate(viol):
         if args.engine == "oracle":
             print(f"\nViolation {k}: {name}")
@@ -403,6 +424,15 @@ def cmd_simulate(args):
     import time
     if not _check_target(args.target):
         return 2
+    # a clear bounds error beats the jit-time shape traceback a
+    # non-positive loop length would produce (ROADMAP sim follow-ups)
+    for nm, val in (("--steps-per-dispatch", args.steps_per_dispatch),
+                    ("--walkers", args.walkers),
+                    ("--steps", args.steps)):
+        if val <= 0:
+            print(f"{nm} must be positive (got {val})",
+                  file=sys.stderr)
+            return 2
     cfg = load_model(args.cfg, bounds=None)
     cfg = _apply_overrides(cfg, args)
     cfg = cfg.with_(invariants=(args.target,))
@@ -539,6 +569,21 @@ def main(argv=None):
                          "files under DIR instead of growing host "
                          "arrays (store_states runs stay RAM-bounded; "
                          "traces replay from the memmaps)")
+    pc.add_argument("--burst", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="fused multi-level dispatch: run whole runs "
+                         "of small BFS levels inside one device "
+                         "program instead of one dispatch+sync per "
+                         "level (--no-burst restores the pure "
+                         "per-level driver; counts are bit-identical "
+                         "either way)")
+    pc.add_argument("--burst-levels", type=int, default=None,
+                    metavar="K",
+                    help="max levels fused per burst device call "
+                         "(default 16)")
+    pc.add_argument("--stats-json", default=None, metavar="FILE",
+                    help="write the run stats JSON (incl. "
+                         "levels_fused/burst_bailouts) to FILE")
     pc.add_argument("--no-store", action="store_true",
                     help="do not retain states (no traces; less memory)")
     pc.add_argument("--max-violations", type=int, default=5)

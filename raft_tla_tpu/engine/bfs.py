@@ -128,6 +128,16 @@ class CheckResult:
     # `violations` list holds only this controller's shards, but this
     # count (from the replicated scalar matrix) is global
     violations_global: int = 0
+    # fused-dispatch telemetry (the multi-level burst fast path):
+    # levels committed inside bursts, burst device calls (each is
+    # exactly one host round trip, whether it committed levels or
+    # not), and calls that ended in a bail back to the per-level path
+    # (a call can both commit levels AND bail) — bench/progress lines
+    # read these to prove the burst engaged instead of silently
+    # bailing every level
+    levels_fused: int = 0
+    burst_dispatches: int = 0
+    burst_bailouts: int = 0
     # punctuated search from cfg prefix pins seeds BFS at the witness
     # END state (models/golden docstring); TLC also counts the prefix
     # interior states.  This is the number of distinct interior states
@@ -198,6 +208,9 @@ def ckpt_write(path, carry, store_states, parents, lanes, states, res,
                 level_sizes=res.level_sizes,
                 viol_global=res.violations_global,
                 pin_interior=res.pin_interior_states,
+                levels_fused=res.levels_fused,
+                burst_dispatches=res.burst_dispatches,
+                burst_bailouts=res.burst_bailouts,
                 n_levels=len(parents), store_states=store_states)
     data["meta"] = np.array(json.dumps({**base, **meta}))
     tmp = path + ".tmp.npz"           # .npz suffix: savez won't append
@@ -308,7 +321,13 @@ def ckpt_result(z, meta) -> "CheckResult":
         overflow_faults=meta["faults"],
         violations_global=meta["viol_global"],
         # .get: round-3 checkpoints predate the field
-        pin_interior_states=meta.get("pin_interior", 0))
+        pin_interior_states=meta.get("pin_interior", 0),
+        # .get: round-7 checkpoints predate the burst telemetry — a
+        # resumed run's stats must stay cumulative, like every other
+        # counter here
+        levels_fused=meta.get("levels_fused", 0),
+        burst_dispatches=meta.get("burst_dispatches", 0),
+        burst_bailouts=meta.get("burst_bailouts", 0))
     for nm, sid in zip(z["viol_names"], z["viol_ids"]):
         res.violations.append(Violation(str(nm), int(sid)))
     return res
@@ -330,6 +349,7 @@ class Engine:
                  ocap: Optional[int] = None,
                  incremental_fp: bool = True,
                  burst: bool = True,
+                 burst_levels: Optional[int] = None,
                  archive_dir: Optional[str] = None):
         enable_persistent_compilation_cache()
         self.cfg = cfg
@@ -407,10 +427,17 @@ class Engine:
                                  static_argnums=1)
         self._fin_jit = jax.jit(self._finalize_impl, donate_argnums=0)
         self._rootfp_jit = jax.jit(self.fpr.fingerprint_batch)
-        # small-level burst (see _burst_impl): on by default; burst=False
+        # small-level burst (see _burst_core): on by default; burst=False
         # restores the pure per-level driver (the A/B is pinned by
-        # tests/test_burst.py)
+        # tests/test_burst.py).  burst_levels caps the levels fused per
+        # device call; the ring width is _burst_chunks frontier chunks.
         self.burst = burst
+        if burst_levels is not None and int(burst_levels) <= 0:
+            raise ValueError(
+                f"burst_levels must be positive, got {burst_levels} "
+                "(use burst=False to disable the fused-level path)")
+        self.burst_levels = (int(burst_levels) if burst_levels
+                             else self._BURST_LEVELS)
         self._burst_jit = jax.jit(self._burst_impl, donate_argnums=0,
                                   static_argnums=1)
 
@@ -912,8 +939,9 @@ class Engine:
         return new_carry, dict(inv_ok=inv_ok, scal=scal)
 
     # ------------------------------------------------------------------
-    # small-level burst: run up to _BURST_LEVELS whole BFS levels in ONE
-    # device call while the frontier fits a single chunk.
+    # small-level burst: run up to burst_levels whole BFS levels in ONE
+    # device call while the frontier fits the burst ring
+    # (_burst_chunks frontier chunks).
     #
     # Motivation (measured, round 5): the tunneled-TPU runtime costs
     # ~172 ms per synchronous dispatch+readback, so a tiny level (one
@@ -923,59 +951,91 @@ class Engine:
     # latency.  The burst folds those levels into one jit: a
     # lax.while_loop whose body is the SAME pipeline as a chunk step
     # (guard-first expand + fingerprint + claim-insert dedup + phase2)
-    # plus the finalize's commit, at chunk width, committing one level
-    # per iteration.  The host reads back ONE stats array for the
-    # whole burst.
+    # plus the finalize's commit; each iteration processes one frontier
+    # CHUNK and commits a level whenever the chunk cursor drains the
+    # frontier, so levels up to _burst_chunks * chunk states still fuse
+    # (round 5's one-chunk burst capped at `chunk`, which left config
+    # #3's 2-5k-state early levels on the per-level path).  The host
+    # reads back ONE stats array for the whole burst.
     #
-    # The while carry holds only chunk-width buffers + the visited
-    # table; the big LCAP buffers pass through OUTSIDE the loop (the
-    # reverted whole-level while_loop driver died on XLA padding the
-    # loop-carried [.., S, S, LCAP] buffers — see the note above
-    # _finalize_impl; the burst's loop-carried state is ~1000x smaller).
+    # The while carry holds only ring-width (_burst_chunks * chunk)
+    # buffers + the visited table; the big LCAP buffers pass through
+    # OUTSIDE the loop (the reverted whole-level while_loop driver died
+    # on XLA padding the loop-carried [.., S, S, LCAP] buffers — see
+    # the note above _finalize_impl; the burst's loop-carried state
+    # stays orders of magnitude smaller).
     #
     # Overflow discipline: any overflow (enabled > FCAP, a family cap,
-    # fresh > chunk, probe budget) BAILS: the level's table inserts are
-    # rolled back on the spot (one chunk per level makes the chunk-
-    # local revert exactly level-local), the pre-level frontier is
-    # kept, and the host replays that level through the ordinary
+    # level outgrowing the ring, probe budget) BAILS: the tripping
+    # chunk's table inserts are cleared on the spot and the level's
+    # earlier chunks' via the in-ring journal, the pre-level frontier
+    # is kept, and the host replays that level through the ordinary
     # per-level path.  Archives (parents/lanes/state rows/inv bits) are
     # recorded per level on device and fetched only when needed
     # (store_states or a violation), so a clean burst costs one small
     # D2H transfer.
+    #
+    # Parent ids ride an explicit per-row gid array (gd) instead of the
+    # chunk step's pg_off arithmetic: the spill engine feeds this same
+    # core (engine/spill) with host-compacted frontiers whose gids are
+    # NOT contiguous; levels born inside the burst refresh gd
+    # arithmetically, which is exactly the per-level id assignment.
     # ------------------------------------------------------------------
 
     _BURST_LEVELS = 16
-    _BS_N = 8                   # stats columns (see _burst_impl)
+    _BURST_CHUNKS = 4           # ring width, in frontier chunks
+    _BS_N = 8                   # stats columns (see _burst_core)
 
-    def _burst_impl(self, carry, fam_caps, levels_left, states_cap):
-        """Returns (carry', out).  out["stats"] is int32
-        [_BURST_LEVELS + 1, _BS_N]: per-level rows
+    @property
+    def _burst_chunks(self) -> int:
+        return self._BURST_CHUNKS
+
+    def _burst_width(self) -> int:
+        """Ring width (states): the largest frontier/level the fused
+        path handles before falling back to the per-level driver."""
+        return self._burst_chunks * self.chunk
+
+    def _burst_core(self, vis, claims, fr, fm, gd, nf, g0, pg0,
+                    fam_caps, levels_left, states_cap, fcap=None):
+        """The fused multi-level loop, over standalone ring-width
+        buffers (no engine carry): fr/fm/gd are [..., KB]/[KB]/[KB]
+        frontier rows (narrow, batch-last), membership mask and global
+        ids; g0 is the next global id to assign.  Returns (stf, out):
+        stf the final while state (vis/claims/fr/fm/gd/nf/g/pg), out
+        the stats + per-level archives.
+
+        out["stats"] is int32 [burst_levels + 1, _BS_N]: per-level rows
         [n_lvl, n_viol, faults, n_expand, n_gen, 0, 0, 0] and a meta
-        row at index _BURST_LEVELS:
+        row at index burst_levels:
         [n_levels_done, bail, n_front_out, viol_any, states_done].
-        out["par"]/out["lane"] are [L_MAX, B] int32, out["st"] the
-        narrow state rows [..., L_MAX, B], out["inv"] bool
-        [n_inv, L_MAX, B] — the per-level archives."""
+        out["par"]/out["lane"] are [L_MAX, KB] int32, out["st"] the
+        narrow state rows [..., L_MAX, KB], out["inv"] bool
+        [n_inv, L_MAX, KB] — the per-level archives."""
         B, A, W = self.chunk, self.A, self.W
-        FCAP = carry["cidx"].shape[0]
-        VCAP = carry["vis"][0].shape[0]
-        L_MAX = self._BURST_LEVELS
+        FCAP = int(fcap) if fcap is not None else self.FCAP
+        KB = fm.shape[0]
+        VCAP = vis[0].shape[0]
+        L_MAX = self.burst_levels
         n_inv = len(self.inv_names)
 
-        front0 = {k: lax.dynamic_slice_in_dim(v, 0, B, axis=v.ndim - 1)
-                  for k, v in carry["front"].items()}
         st = dict(
-            vis=carry["vis"], claims=carry["claims"],
-            fr=front0, fm=carry["fmask"][:B], nf=carry["n_front"],
+            vis=vis, claims=claims, fr=fr, fm=fm, gd=gd, nf=nf,
+            base=jnp.int32(0), nl=jnp.int32(0), gl=jnp.int32(0),
+            lv={k: jnp.zeros_like(v) for k, v in fr.items()},
+            lvp=jnp.full((KB,), -1, jnp.int32),
+            lvlane=jnp.full((KB,), -1, jnp.int32),
+            lin=jnp.ones((n_inv, KB), bool),
+            lco=jnp.ones((KB,), bool),
+            jsl=jnp.zeros((KB,), jnp.int32),
             li=jnp.int32(0), done=jnp.int32(0),
-            g=carry["g_off"], pg=carry["pg_off"],
+            g=g0, pg=pg0,
             bail=jnp.bool_(False), viol=jnp.bool_(False),
             stats=jnp.zeros((L_MAX, self._BS_N), jnp.int32),
-            opar=jnp.full((L_MAX, B), -1, jnp.int32),
-            olane=jnp.full((L_MAX, B), -1, jnp.int32),
-            ost={k: jnp.zeros(v.shape[:-1] + (L_MAX, B), v.dtype)
-                 for k, v in front0.items()},
-            oinv=jnp.ones((n_inv, L_MAX, B), bool),
+            opar=jnp.full((L_MAX, KB), -1, jnp.int32),
+            olane=jnp.full((L_MAX, KB), -1, jnp.int32),
+            ost={k: jnp.zeros(v.shape[:-1] + (L_MAX, KB), v.dtype)
+                 for k, v in fr.items()},
+            oinv=jnp.ones((n_inv, L_MAX, KB), bool),
         )
 
         def cond(st):
@@ -983,10 +1043,15 @@ class Engine:
                     & (st["nf"] > 0) & (st["done"] < states_cap))
 
         def body(st):
-            sv = widen(st["fr"])
-            valid = (jnp.arange(B, dtype=jnp.int32) < st["nf"]) & st["fm"]
-            cand_c, elive, fp, take, famx_c, n_e = self._expand_fp_chunk(
-                sv, valid, fam_caps, FCAP)
+            base, nl = st["base"], st["nl"]
+            sv = widen({k: lax.dynamic_slice_in_dim(v, base, B,
+                                                    axis=v.ndim - 1)
+                        for k, v in st["fr"].items()})
+            fm_c = lax.dynamic_slice_in_dim(st["fm"], base, B)
+            valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
+                     st["nf"]) & fm_c
+            cand_c, elive, fp, take, famx_c, n_e = \
+                self._expand_fp_chunk(sv, valid, fam_caps, FCAP)
             bail = (n_e > FCAP) | jnp.any(
                 famx_c > jnp.asarray(fam_caps, jnp.int32))
             keys = tuple(jnp.where(elive, fp[w], U32MAX)
@@ -996,70 +1061,111 @@ class Engine:
                 st["vis"], st["claims"], keys, elive & ~bail, ranks)
             bail = bail | hv
             n_fresh = fresh.sum(dtype=jnp.int32)
-            n_genl = elive.sum(dtype=jnp.int32)
-            bail = bail | (n_fresh > B)
-            # bail => this level never happened: clear its inserts (the
-            # one-chunk level makes the chunk-local revert level-exact)
+            bail = bail | (nl + n_fresh > KB)
+            # bail => this level never happened: clear THIS chunk's
+            # inserts on the spot and the level's earlier chunks' via
+            # the ring journal (rollback-safe — _probe_insert note)
             ridx = jnp.where(fresh & bail, pos, VCAP)
             vis = tuple(vis[w].at[ridx].set(U32MAX, mode="drop")
                         for w in range(W))
+            jb = jnp.where((jnp.arange(KB, dtype=jnp.int32) < nl) & bail,
+                           st["jsl"], VCAP)
+            vis = tuple(vis[w].at[jb].set(U32MAX, mode="drop")
+                        for w in range(W))
             fresh = fresh & ~bail
-            commit = ~bail
+            n_fresh = jnp.where(bail, 0, n_fresh)
+            n_genl = jnp.where(bail, 0, elive.sum(dtype=jnp.int32))
+            gl2 = st["gl"] + n_genl
+            nl2 = nl + n_fresh
 
-            # compact fresh candidates -> chunk-wide level rows (same
-            # enumeration order as the per-level path: candidate-slot
-            # ascending = parent-major, lane ascending)
-            lpos = jnp.where(fresh,
-                             jnp.cumsum(fresh.astype(jnp.int32)) - 1, B)
-            lidx = jnp.zeros((B,), jnp.int32).at[lpos].set(
-                jnp.arange(FCAP, dtype=jnp.int32), mode="drop")
-            rows = {k: cand_c[k][..., lidx] for k in cand_c}
-            valid2 = jnp.arange(B, dtype=jnp.int32) < n_fresh
-            inv, con = self._phase2_T(rows)
-            inv_ok = (inv | ~valid2[None, :]) if n_inv \
-                else jnp.ones((0, B), bool)
+            # scatter the fresh rows into the level ring at
+            # [nl, nl + n_fresh) (candidate-slot ascending =
+            # parent-major, lane ascending — the per-level order)
+            lpos = jnp.where(
+                fresh, nl + jnp.cumsum(fresh.astype(jnp.int32)) - 1, KB)
+            rows_n = narrow(self.lay, cand_c)
+            lv = {k: st["lv"][k].at[..., lpos].set(rows_n[k],
+                                                   mode="drop")
+                  for k in st["lv"]}
+            par_row = jnp.clip(base + take // A, 0, KB - 1)
+            pgid = st["gd"][par_row]
+            lvp = st["lvp"].at[lpos].set(pgid, mode="drop")
+            lvlane = st["lvlane"].at[lpos].set(take % A, mode="drop")
+            jsl = st["jsl"].at[lpos].set(pos, mode="drop")
+            inv, con = self._phase2_T(cand_c)
+            lin = (st["lin"].at[:, lpos].set(inv, mode="drop")
+                   if n_inv else st["lin"])
+            lco = st["lco"].at[lpos].set(con, mode="drop")
+
+            new_base = base + B
+            level_done = ~bail & (new_base >= st["nf"])
+
+            # level commit (predicated — a mid-level chunk leaves the
+            # frontier and archives untouched)
+            validrow = jnp.arange(KB, dtype=jnp.int32) < nl2
+            inv_ok = ((lin | ~validrow[None, :]) if n_inv
+                      else jnp.ones((0, KB), bool))
             n_viol = (~inv_ok).sum(dtype=jnp.int32)
-            faults = ((rows["ctr"][C_OVERFLOW] > 0) &
-                      valid2).sum(dtype=jnp.int32)
-            n_expand = (con & valid2).sum(dtype=jnp.int32)
-            lane_ids = take[lidx]
-            par_gid = jnp.where(valid2, st["pg"] + lane_ids // A, -1)
-            lane = jnp.where(valid2, lane_ids % A, -1)
-            rows_n = narrow(self.lay, rows)
-
+            faults = ((lv["ctr"][C_OVERFLOW] > 0) &
+                      validrow).sum(dtype=jnp.int32)
+            n_expand = (lco & validrow).sum(dtype=jnp.int32)
             li = st["li"]
-            row = jnp.where(commit, jnp.stack(
-                [n_fresh, n_viol, faults, n_expand, n_genl,
-                 jnp.int32(0), jnp.int32(0), jnp.int32(0)]),
-                jnp.zeros((self._BS_N,), jnp.int32))
+            row = jnp.stack([nl2, n_viol, faults, n_expand, gl2,
+                             jnp.int32(0), jnp.int32(0), jnp.int32(0)])
+
             new = dict(st)
             new["vis"], new["claims"] = vis, claims
-            new["stats"] = lax.dynamic_update_slice(
-                st["stats"], row[None], (li, 0))
-            new["opar"] = lax.dynamic_update_slice(
-                st["opar"], par_gid[None], (li, 0))
-            new["olane"] = lax.dynamic_update_slice(
-                st["olane"], lane[None], (li, 0))
+            new["lv"], new["lvp"], new["lvlane"] = lv, lvp, lvlane
+            new["lin"], new["lco"], new["jsl"] = lin, lco, jsl
+            new["stats"] = jnp.where(
+                level_done,
+                lax.dynamic_update_slice(st["stats"], row[None],
+                                         (li, 0)),
+                st["stats"])
+            new["opar"] = jnp.where(
+                level_done,
+                lax.dynamic_update_slice(st["opar"], lvp[None],
+                                         (li, 0)),
+                st["opar"])
+            new["olane"] = jnp.where(
+                level_done,
+                lax.dynamic_update_slice(st["olane"], lvlane[None],
+                                         (li, 0)),
+                st["olane"])
             new["ost"] = {
-                k: lax.dynamic_update_slice(
-                    v, rows_n[k][..., None, :],
-                    (0,) * (v.ndim - 2) + (li, 0))
+                k: jnp.where(
+                    level_done,
+                    lax.dynamic_update_slice(
+                        v, lv[k][..., None, :],
+                        (0,) * (v.ndim - 2) + (li, 0)),
+                    v)
                 for k, v in st["ost"].items()}
             if n_inv:
-                new["oinv"] = lax.dynamic_update_slice(
-                    st["oinv"], inv_ok[:, None, :], (0, li, 0))
-            # frontier advance only on commit (bail keeps the pre-level
-            # frontier so the host can replay the level exactly)
-            new["fr"] = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(commit, a, b), rows_n, st["fr"])
-            new["fm"] = jnp.where(commit, con & valid2, st["fm"])
-            new["nf"] = jnp.where(commit, n_fresh, st["nf"])
-            new["pg"] = jnp.where(commit, st["g"], st["pg"])
-            new["g"] = st["g"] + jnp.where(commit, n_fresh, 0)
-            new["done"] = st["done"] + jnp.where(commit, n_fresh, 0)
-            new["li"] = li + commit.astype(jnp.int32)
+                new["oinv"] = jnp.where(
+                    level_done,
+                    lax.dynamic_update_slice(st["oinv"],
+                                             inv_ok[:, None, :],
+                                             (0, li, 0)),
+                    st["oinv"])
+            # frontier swap only at a level boundary (bail keeps the
+            # pre-level frontier so the host can replay it exactly);
+            # rows past nl2 are stale but masked by nf/fm downstream
+            new["fr"] = {k: jnp.where(level_done, lv[k], st["fr"][k])
+                         for k in st["fr"]}
+            new["fm"] = jnp.where(level_done, lco & validrow, st["fm"])
+            new["nf"] = jnp.where(level_done, nl2, st["nf"])
+            new["gd"] = jnp.where(
+                level_done, st["g"] + jnp.arange(KB, dtype=jnp.int32),
+                st["gd"])
+            new["pg"] = jnp.where(level_done, st["g"], st["pg"])
+            new["g"] = st["g"] + jnp.where(level_done, nl2, 0)
+            new["done"] = st["done"] + jnp.where(level_done, nl2, 0)
+            new["li"] = li + level_done.astype(jnp.int32)
+            new["base"] = jnp.where(level_done, 0, new_base)
+            new["nl"] = jnp.where(level_done, 0, nl2)
+            new["gl"] = jnp.where(level_done, 0, gl2)
             new["bail"] = bail
-            new["viol"] = st["viol"] | (commit & (n_viol > 0))
+            new["viol"] = st["viol"] | (level_done & (n_viol > 0))
             return new
 
         st = lax.while_loop(cond, body, st)
@@ -1071,17 +1177,32 @@ class Engine:
         meta = meta.at[3].set(st["viol"].astype(jnp.int32))
         meta = meta.at[4].set(st["done"])
         stats = jnp.concatenate([st["stats"], meta[None]], axis=0)
+        return st, dict(stats=stats, par=st["opar"], lane=st["olane"],
+                        st=st["ost"], inv=st["oinv"])
 
-        fmask = jnp.zeros_like(carry["fmask"]).at[:B].set(st["fm"])
+    def _burst_impl(self, carry, fam_caps, levels_left, states_cap):
+        """Classic-carry wrapper around _burst_core: slice the ring out
+        of the LCAP buffers, run the fused loop, paste the surviving
+        frontier back.  Returns (carry', out) — out as in
+        _burst_core."""
+        KB = self._burst_width()
+        front0 = {k: lax.dynamic_slice_in_dim(v, 0, KB, axis=v.ndim - 1)
+                  for k, v in carry["front"].items()}
+        # classic frontiers are contiguous: row r has id pg_off + r
+        gd0 = carry["pg_off"] + jnp.arange(KB, dtype=jnp.int32)
+        stf, out = self._burst_core(
+            carry["vis"], carry["claims"], front0,
+            carry["fmask"][:KB], gd0, carry["n_front"], carry["g_off"],
+            carry["pg_off"], fam_caps, levels_left, states_cap,
+            fcap=carry["cidx"].shape[0])
+        fmask = jnp.zeros_like(carry["fmask"]).at[:KB].set(stf["fm"])
         front = {k: lax.dynamic_update_slice_in_dim(
-                     v, st["fr"][k], 0, axis=v.ndim - 1)
+                     v, stf["fr"][k], 0, axis=v.ndim - 1)
                  for k, v in carry["front"].items()}
-        new_carry = dict(carry, vis=st["vis"], claims=st["claims"],
-                         front=front, fmask=fmask, n_front=st["nf"],
-                         g_off=st["g"], pg_off=st["pg"])
-        return new_carry, dict(stats=stats, par=st["opar"],
-                               lane=st["olane"], st=st["ost"],
-                               inv=st["oinv"])
+        new_carry = dict(carry, vis=stf["vis"], claims=stf["claims"],
+                         front=front, fmask=fmask, n_front=stf["nf"],
+                         g_off=stf["g"], pg_off=stf["pg"])
+        return new_carry, out
 
     # ------------------------------------------------------------------
 
@@ -1373,18 +1494,26 @@ class Engine:
             res.seconds = time.time() - t0
             return res
 
+        # burst_ok gates the speculative burst entry: a burst that
+        # committed levels and THEN bailed leaves the bailing level's
+        # pre-level frontier intact, so re-entering the burst would
+        # deterministically replay the same chunks and bail again — one
+        # wasted round trip (the exact cost the burst cuts).  Skip the
+        # burst for that one level; the per-level path re-arms it.
+        burst_ok = True
         while n_front and depth < max_depth and \
                 res.distinct_states < max_states:
-            if self.burst and n_front <= self.chunk:
-                # small-level burst: run up to _BURST_LEVELS levels in
-                # one device call (see _burst_impl).  nlev == 0 means
+            if self.burst and burst_ok and \
+                    n_front <= self._burst_width():
+                # small-level burst: run up to burst_levels levels in
+                # one device call (see _burst_core).  nlev == 0 means
                 # the very first level bailed on an overflow — fall
                 # through and let the per-level path (with its growth
                 # machinery) run that level.
                 t1 = time.time()
                 carry = grow_table_if_needed(
-                    carry, min_add=self._BURST_LEVELS * self.chunk)
-                lv_left = min(self._BURST_LEVELS, max_depth - depth)
+                    carry, min_add=self.burst_levels * self._burst_width())
+                lv_left = min(self.burst_levels, max_depth - depth)
                 st_cap = max(1, min(max_states - res.distinct_states,
                                     2 ** 31 - 1))
                 carry, bout = self._burst_jit(
@@ -1392,7 +1521,12 @@ class Engine:
                     jnp.int32(st_cap))
                 stats = np.asarray(bout["stats"])  # the ONE burst sync
                 nlev = int(stats[-1, 0])
+                bailed = bool(stats[-1, 1])
+                res.burst_dispatches += 1
+                res.burst_bailouts += int(bailed)
                 if nlev:
+                    burst_ok = not bailed
+                    d0 = depth
                     n_front = int(stats[-1, 2])
                     viol_any = bool(stats[-1, 3])
                     par_h = lane_h = st_h = inv_h = None
@@ -1432,6 +1566,11 @@ class Engine:
                             pass     # all-pruned frontier: not a level
                         else:
                             depth += 1
+                            # counted HERE, not as the raw loop-trip
+                            # count, so levels_fused ≡ depth advanced
+                            # and bench's (depth - levels_fused) is the
+                            # per-level-driver level count exactly
+                            res.levels_fused += 1
                             res.level_sizes.append(n_expand)
                         n_states += n_lvl
                         n_vis += n_lvl
@@ -1440,8 +1579,12 @@ class Engine:
                             "state-id space exhausted (2^31 ids): run "
                             "exceeds the engine's int32 global-id width")
                     t_dev += time.time() - t1
+                    # fire if ANY multiple of checkpoint_every was
+                    # crossed this burst (a multi-level depth jump can
+                    # step over every exact multiple)
+                    every = max(1, checkpoint_every)
                     if checkpoint_path is not None and \
-                            depth % max(1, checkpoint_every) == 0:
+                            depth // every > d0 // every:
                         self._save_checkpoint(checkpoint_path, carry,
                                               res, depth, n_states,
                                               n_vis, n_front)
@@ -1453,6 +1596,7 @@ class Engine:
                               f"frontier {n_front}, "
                               f"{time.time() - t1:.2f}s")
                     continue
+            burst_ok = True        # re-arm after a per-level level
             depth += 1
             t1 = time.time()
             carry = grow_table_if_needed(carry)

@@ -94,10 +94,18 @@ class SpillEngine(Engine):
                  host_table: bool = False, partitions: int = 4,
                  part_cap: int = 1 << 12,
                  dev_keys: Optional[int] = None,
+                 burst: bool = True,
+                 burst_levels: Optional[int] = None,
                  archive_dir: Optional[str] = None):
+        # burst (fused multi-level dispatch) is ON by default since
+        # round 8 — the tiny early levels of a deep spill run pay the
+        # same tunneled dispatch floor as the classic engine's; pass
+        # burst=False to force the pure per-level/segment driver
+        # (tests/test_burst.py pins the A/B)
         super().__init__(cfg, chunk=chunk, store_states=store_states,
                          lcap=seg, vcap=vcap, fcap=fcap, ocap=ocap,
-                         burst=False, archive_dir=archive_dir)
+                         burst=burst, burst_levels=burst_levels,
+                         archive_dir=archive_dir)
         self.SEGL = self.LCAP          # level segment rows (can grow)
         self.SEGF = self.LCAP          # frontier segment rows (fixed)
         self.sync_every = max(1, int(sync_every))
@@ -137,6 +145,14 @@ class SpillEngine(Engine):
         self._member_cache = {}        # sweep-membership jit per shape
         self._sstep_jit = jax.jit(self._spill_step_impl,
                                   donate_argnums=0, static_argnums=1)
+        # spill-aware fused multi-level burst (engine/bfs._burst_core
+        # over standalone ring buffers — the spill carry's segment
+        # shapes never enter the loop).  fcap rides as a static arg:
+        # unlike the classic wrapper there is no carry-shape anchor, so
+        # an FCAP growth must force a retrace explicitly.
+        self._spill_burst_jit = jax.jit(self._spill_burst_call,
+                                        donate_argnums=(0, 1),
+                                        static_argnums=(7, 8))
 
     # ------------------------------------------------------------------
     # fused per-chunk step (spill twin of Engine._chunk_step_impl)
@@ -616,6 +632,146 @@ class SpillEngine(Engine):
         return dict(carry, vis=vis, claims=claims), n
 
     # ------------------------------------------------------------------
+    # spill-aware fused multi-level burst: while the whole frontier
+    # fits the burst ring (engine/bfs burst notes) and no host-table
+    # sweep is due (host_table mode sweeps EVERY level, so it keeps the
+    # per-level path), run whole levels on device — one dispatch + one
+    # small stats readback per burst instead of the
+    # upload/window/spill round trips of the segment driver.  The
+    # moment a level outgrows the ring, any cap trips, or the space
+    # widens past the ring, the burst bails with the pre-level frontier
+    # intact and the segment driver takes over — a spill flush or
+    # segment boundary can therefore never be needed INSIDE a burst
+    # (the ring is far smaller than a segment).
+    # ------------------------------------------------------------------
+
+    def _spill_burst_call(self, vis, claims, fr, fm, gd, nf, g0,
+                          fam_caps, fcap, levels_left, states_cap):
+        stf, out = self._burst_core(vis, claims, fr, fm, gd, nf, g0,
+                                    g0, fam_caps, levels_left,
+                                    states_cap, fcap=fcap)
+        return (stf["vis"], stf["claims"], stf["fr"], stf["fm"],
+                stf["gd"], stf["nf"], out)
+
+    def _burst_spill_levels(self, carry, frontier_blocks, res, depth,
+                            n_states, n_vis, max_depth, max_states,
+                            verbose):
+        """One fused multi-level device call on a tiny frontier.
+        Harvests every committed level (counts, archives, violations)
+        and rebuilds the host frontier blocks from the surviving ring.
+        Returns (carry, frontier_blocks, depth, n_states, n_vis,
+        fused, bailed) — fused=False means the first level bailed
+        (caps/ring overflow) and the segment driver must run it
+        instead; bailed=True means the call ended in a bail (even
+        after committing levels), so re-entering the burst on the
+        unchanged frontier would deterministically bail again."""
+        t1 = time.time()
+        lay = self.lay
+        KB = self._burst_width()
+        n_front = sum(int(g.shape[0]) for _r, g in frontier_blocks)
+        rows_cat, gids_cat = self._cat_seg(
+            [r for r, _g in frontier_blocks],
+            [g for _r, g in frontier_blocks])
+        one = narrow(lay, encode(lay, *init_state(self.cfg)))
+        fr_np = {k: np.zeros(v.shape + (KB,), v.dtype)
+                 for k, v in one.items()}
+        for k in fr_np:
+            fr_np[k][..., :n_front] = rows_cat[k]
+        gd_np = np.full((KB,), -1, np.int32)
+        gd_np[:n_front] = gids_cat
+        fm_np = np.zeros((KB,), bool)
+        fm_np[:n_front] = True
+        carry = self._grow_table_if_needed(
+            carry, n_vis, min_add=self.burst_levels * KB)
+        lv_left = min(self.burst_levels, max_depth - depth)
+        st_cap = max(1, min(max_states - res.distinct_states,
+                            2 ** 31 - 1))
+        vis, claims, frd, fmd, gdd, _nfd, out = self._spill_burst_jit(
+            carry["vis"], carry["claims"],
+            {k: jnp.asarray(v) for k, v in fr_np.items()},
+            jnp.asarray(fm_np), jnp.asarray(gd_np),
+            jnp.int32(n_front), jnp.int32(n_states),
+            self.FAM_CAPS, self.FCAP,
+            jnp.int32(lv_left), jnp.int32(st_cap))
+        carry = dict(carry, vis=vis, claims=claims)
+        stats = np.asarray(out["stats"])       # the ONE burst sync
+        nlev = int(stats[-1, 0])
+        bailed = bool(stats[-1, 1])
+        res.burst_dispatches += 1
+        res.burst_bailouts += int(bailed)
+        if nlev == 0:
+            return (carry, frontier_blocks, depth, n_states, n_vis,
+                    False, bailed)
+        viol_any = bool(stats[-1, 3])
+        par_h = lane_h = st_h = inv_h = None
+        if self.store_states or viol_any:
+            par_h = np.asarray(out["par"])
+            lane_h = np.asarray(out["lane"])
+            st_h = {k: np.asarray(v) for k, v in out["st"].items()}
+            inv_h = np.asarray(out["inv"])
+        for li in range(nlev):
+            n_lvl, n_viol, faults, n_expand, n_genl = (
+                int(x) for x in stats[li, :5])
+            res.distinct_states += n_lvl
+            res.generated_states += n_genl
+            res.overflow_faults += faults
+            res.violations_global += n_viol
+            if self.store_states and n_lvl:
+                # n_lvl == 0 appends nothing: the spill archive's
+                # gid->row mapping is cumulative, not per-level
+                # (flush_archives skips empty levels the same way)
+                self._archive_level(
+                    par_h[li, :n_lvl].copy(),
+                    lane_h[li, :n_lvl].copy(),
+                    {k: np.moveaxis(v[..., li, :n_lvl], -1, 0).copy()
+                     for k, v in st_h.items()})
+            if n_viol:
+                rows = {k: np.moveaxis(v[..., li, :n_lvl], -1, 0)
+                        for k, v in st_h.items()}
+                for j, nm in enumerate(self.inv_names):
+                    for s in np.nonzero(~inv_h[j, li, :n_lvl])[0]:
+                        vsv, vh = decode(
+                            lay, {kk: np.asarray(rows[kk][s])
+                                  for kk in rows})
+                        res.violations.append(Violation(
+                            nm, n_states + int(s), state=vsv,
+                            hist=vh))
+            if n_lvl or n_genl:
+                depth += 1
+                # counted inside the depth gate (engine/bfs does the
+                # same) so levels_fused ≡ depth advanced in every
+                # engine and (depth - levels_fused) is exactly the
+                # per-level-driver level count
+                res.levels_fused += 1
+                res.level_sizes.append(n_expand)
+            n_states += n_lvl
+            n_vis += n_lvl
+        if n_states >= 2 ** 31 - 1:
+            raise RuntimeError(
+                "state-id space exhausted (2^31 ids): run exceeds "
+                "the engine's int32 global-id width")
+        # rebuild the host frontier from the surviving ring: pruned
+        # rows drop here (prune-not-expand stays host-side outside the
+        # burst, exactly as if the level had spilled)
+        nf = int(stats[-1, 2])
+        frontier_blocks = []
+        if nf:
+            keep = np.nonzero(np.asarray(fmd)[:nf])[0]
+            if len(keep):
+                fr_h = {k: np.ascontiguousarray(
+                            np.asarray(v)[..., keep])
+                        for k, v in frd.items()}
+                frontier_blocks = [
+                    (fr_h, np.asarray(gdd)[keep].astype(np.int32))]
+        if verbose:
+            print(f"burst: {nlev} levels to depth {depth} "
+                  f"(total {res.distinct_states}), frontier "
+                  f"{sum(int(g.shape[0]) for _r, g in frontier_blocks)}, "
+                  f"{time.time() - t1:.2f}s", flush=True)
+        return (carry, frontier_blocks, depth, n_states, n_vis, True,
+                bailed)
+
+    # ------------------------------------------------------------------
 
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
@@ -786,8 +942,38 @@ class SpillEngine(Engine):
         # summary round trip.  Late detection is safe: a trip gates
         # every later chunk into a no-op (sticky flags), and the spill
         # floor reserves margin for the extra in-flight window.
+        # burst_ok: a burst that committed levels then bailed keeps the
+        # bailing level's frontier intact — re-entering would replay
+        # the identical chunks and bail again (one wasted round trip),
+        # so skip the burst for that level; the segment driver re-arms
+        burst_ok = True
         while frontier_blocks and depth < max_depth and \
                 res.distinct_states < max_states:
+            if (self.burst and burst_ok and not self.host_table and
+                    sum(int(g.shape[0]) for _r, g in frontier_blocks)
+                    <= self._burst_width()):
+                d0 = depth
+                (carry, frontier_blocks, depth, n_states, n_vis,
+                 fused, bailed) = self._burst_spill_levels(
+                    carry, frontier_blocks, res, depth, n_states,
+                    n_vis, max_depth, max_states, verbose)
+                if fused:
+                    burst_ok = not bailed
+                    # fire if ANY multiple of checkpoint_every was
+                    # crossed by the burst's multi-level depth jump
+                    every = max(1, checkpoint_every)
+                    if checkpoint_path is not None and \
+                            depth // every > d0 // every:
+                        self._save_spill_checkpoint(
+                            checkpoint_path, carry, res,
+                            frontier_blocks, frontier_keys, depth,
+                            n_states, n_vis)
+                    if stop_on_violation and res.violations:
+                        break
+                    continue
+                # first level bailed: the segment driver (with its
+                # growth machinery) runs it below
+            burst_ok = True        # re-arm after a per-level level
             depth += 1
             t1 = time.time()
             self._lvl_parts.append([])
@@ -1112,14 +1298,16 @@ class SpillEngine(Engine):
 
     # ------------------------------------------------------------------
 
-    def _grow_table_if_needed(self, carry, n_vis: int):
+    def _grow_table_if_needed(self, carry, n_vis: int, min_add: int = 0):
         """Proactive load check, run at segment boundaries AND after
         every mid-segment spill/trip (n_vis moves there too): the table
-        can take at most SEGL - FCAP more keys before the next check.
+        can take at most SEGL - FCAP more keys before the next check
+        (``min_add`` raises that bound — the fused burst can admit up
+        to burst_levels ring-widths before its next host sync).
         A rehash here is safe mid-segment — the cursor and frontier
         segment ride in the carry untouched — and far cheaper than the
         reactive hovf trip+replay it preempts."""
-        need = n_vis + self.SEGL - self.OCAP
+        need = n_vis + max(self.SEGL - self.OCAP, min_add)
         if need > self._LOAD_MAX * self.VCAP:
             while need > self._LOAD_MAX * self.VCAP:
                 self.VCAP *= 4

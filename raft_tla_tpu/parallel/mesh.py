@@ -113,7 +113,9 @@ class ShardedEngine(Engine):
     def __init__(self, cfg: ModelConfig, devices=None, chunk: int = 512,
                  store_states: bool = True,
                  lcap: int = 1 << 14, vcap: int = 1 << 17,
-                 fcap: Optional[int] = None, scap: Optional[int] = None):
+                 fcap: Optional[int] = None, scap: Optional[int] = None,
+                 burst: bool = True,
+                 burst_levels: Optional[int] = None):
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(np.array(devices), axis_names=("d",))
         self.D = len(devices)
@@ -121,7 +123,8 @@ class ShardedEngine(Engine):
             f"chunk {chunk} not divisible by {self.D} devices"
         self.BL = chunk // self.D              # frontier rows per device
         super().__init__(cfg, chunk=chunk, store_states=store_states,
-                         lcap=lcap, vcap=vcap, fcap=fcap)
+                         lcap=lcap, vcap=vcap, fcap=fcap, burst=burst,
+                         burst_levels=burst_levels)
         # the sharded step computes full per-candidate fingerprints: the
         # incremental per-action path (engine/fingerprint) is not wired
         # into _local_step yet, so make the inherited flag's inertness
@@ -144,6 +147,14 @@ class ShardedEngine(Engine):
         # step-atomic trip discipline: off here (whole-level journal
         # replay); the spill-composed subclass turns it on
         self._step_atomic = False
+        # in-burst frontier policy: this engine keeps constraint-pruned
+        # rows in place under fmask (prune-not-expand, engine/bfs);
+        # the spill-composed subclass compacts them away at each burst
+        # level commit, because its HOST path drops pruned rows before
+        # re-upload — the window packing (and so the level shards' row
+        # order and gid assignment) must match the un-bursted path
+        # exactly
+        self._burst_compact_frontier = False
         # appended rows' fingerprints ride the level shard (lkey) only
         # when the spill-composed subclass runs its host-partitioned
         # table: they feed the per-device partition sweep + cache
@@ -151,6 +162,11 @@ class ShardedEngine(Engine):
         self._track_keys = False
         self._level_jit = jax.jit(self._sharded_level_call,
                                   donate_argnums=0, static_argnums=1)
+        # fused K-level driver (_shard_burst): the level program's body
+        # inside one more while_loop, one stats matrix back per burst
+        self._burst_mesh_jit = jax.jit(self._sharded_burst_call,
+                                       donate_argnums=0,
+                                       static_argnums=1)
 
     def _round_lb(self, n: int) -> int:
         b = self.BL
@@ -539,6 +555,202 @@ class ShardedEngine(Engine):
         return new_c, dict(inv_ok=inv_ok, scal=scal)
 
     # -----------------------------------------------------------------
+    # fused K-level driver (the mesh twin of engine/bfs._burst_core):
+    # the _shard_level body — lock-step chunk steps over all_to_all —
+    # becomes the body of ONE MORE while_loop, committing one level per
+    # iteration inside the SAME shard_map program, with the per-level
+    # all_gather id-assignment kept in-loop and ONE packed
+    # [D, L_MAX+1, n_scalars] stats matrix read back per burst.  A
+    # shard_map dispatch + scalar sync per level is "genuinely
+    # expensive" (bfs.py finalize note) — this removes all but one of
+    # them for runs of small levels.
+    #
+    # Archive discipline: per-level parent/lane/state/inv rows are
+    # copied into [L_MAX, KBd]-wide ring buffers, KBd =
+    # min(_burst_chunks * BL, LB) rows per shard; a level whose shard
+    # outgrows KBd — or that trips ANY overflow — is abandoned via the
+    # whole-level journal rollback (_local_finalize's abandon,
+    # replicated here) and replayed by the per-level path.  The
+    # loop-carried state adds only the ring archives on top of what
+    # _shard_level already loop-carries.
+    # -----------------------------------------------------------------
+
+    def _mesh_burst_width(self) -> int:
+        """Per-shard burst ring rows (the host entry gate compares the
+        per-device frontier max against this)."""
+        return min(self._burst_chunks * self.BL, self.LB)
+
+    def _sharded_burst_call(self, carry, fam_caps, levels_left,
+                            states_cap):
+        specs = jax.tree_util.tree_map(lambda _: P("d"), carry)
+        st_specs = {k: P("d") for k in carry["lvl"]}
+        out_specs = (specs, dict(stats=P(None), par=P("d"),
+                                 lane=P("d"), st=st_specs,
+                                 inv=P("d")))
+        return _shard_map(
+            lambda c, ll, sc: self._shard_burst(c, fam_caps, ll, sc),
+            self.mesh, (specs, P(), P()), out_specs)(
+                carry, levels_left, states_cap)
+
+    def _shard_burst(self, carry, fam_caps, levels_left, states_cap):
+        c0 = jax.tree_util.tree_map(lambda x: x[0], carry)
+        LB = c0["fmask"].shape[0]
+        VB = c0["vis"][0].shape[0]
+        KBd = self._mesh_burst_width()
+        L_MAX = self.burst_levels
+        n_inv = len(self.inv_names)
+        d_idx = jax.lax.axis_index("d")
+
+        st = dict(
+            c=c0, li=jnp.int32(0), done=jnp.int32(0),
+            bail=jnp.bool_(False), viol=jnp.bool_(False),
+            stats=jnp.zeros((L_MAX, self._BS_N), jnp.int32),
+            opar=jnp.full((L_MAX, KBd), -1, jnp.int32),
+            olane=jnp.full((L_MAX, KBd), -1, jnp.int32),
+            ost={k: jnp.zeros((L_MAX, KBd) + v.shape[1:], v.dtype)
+                 for k, v in c0["lvl"].items()},
+            oinv=jnp.ones((L_MAX, KBd, n_inv), bool),
+        )
+
+        def cond(st):
+            # every operand is replicated (derived from all_gathers),
+            # so the decision is uniform across the mesh
+            more = jax.lax.all_gather(st["c"]["n_front"] > 0,
+                                      "d").any()
+            return (~st["bail"] & ~st["viol"]
+                    & (st["li"] < levels_left) & more
+                    & (st["done"] < states_cap))
+
+        def body(st):
+            def chunk_cond(cc):
+                more = cc["base"] < cc["n_front"]
+                bad = cc["ovf"] | cc["fovf"] | cc["sovf"] | cc["hovf"]
+                flags = jax.lax.all_gather(jnp.stack([more, bad]), "d")
+                return flags[:, 0].any() & ~flags[:, 1].any()
+
+            c = lax.while_loop(
+                chunk_cond, lambda cc: self._local_step(cc, fam_caps),
+                st["c"])
+            n_lvl = c["n_lvl"]
+            bad = jax.lax.all_gather(
+                c["ovf"] | c["fovf"] | c["sovf"] | c["hovf"] |
+                (n_lvl > KBd), "d").any()
+            validrow = jnp.arange(LB, dtype=jnp.int32) < n_lvl
+            inv_ok = (c["linv"] | ~validrow[:, None]
+                      if n_inv else c["linv"])
+            con = c["lcon"]
+            n_viol = (~inv_ok).sum(dtype=jnp.int32)
+            faults = ((c["lvl"]["ctr"][:, C_OVERFLOW] > 0) &
+                      validrow).sum(dtype=jnp.int32)
+            n_expand = (con & validrow).sum(dtype=jnp.int32)
+            nl_vec = jax.lax.all_gather(n_lvl, "d")
+            prefix = jnp.cumsum(nl_vec) - nl_vec
+            total = nl_vec.sum()
+            viol_g = jax.lax.all_gather(n_viol, "d").sum() > 0
+            gen_l = c["n_gen"]
+            li = st["li"]
+
+            def commit(op):
+                c, opar, olane, ost, oinv = op
+                opar = lax.dynamic_update_slice(
+                    opar, c["lpar"][:KBd][None], (li, 0))
+                olane = lax.dynamic_update_slice(
+                    olane, c["llane"][:KBd][None], (li, 0))
+                ost = {k: lax.dynamic_update_slice(
+                           ost[k], c["lvl"][k][:KBd][None],
+                           (li,) + (0,) * (ost[k].ndim - 1))
+                       for k in ost}
+                oinv = lax.dynamic_update_slice(
+                    oinv, inv_ok[:KBd][None], (li, 0, 0))
+                gids_all = c["g_off"] + prefix[d_idx] + \
+                    jnp.arange(LB, dtype=jnp.int32)
+                if self._burst_compact_frontier:
+                    # spill-composed mode: drop pruned rows from the
+                    # next frontier on device, exactly as the host does
+                    # between levels (archives above keep ALL rows) —
+                    # the window packing, and so every later level's
+                    # row order and gids, must match the un-bursted
+                    # path bit-for-bit
+                    keep = con & validrow
+                    n_keep = keep.sum(dtype=jnp.int32)
+                    kpos = jnp.where(
+                        keep,
+                        jnp.cumsum(keep.astype(jnp.int32)) - 1, LB)
+                    kidx = jnp.zeros((LB,), jnp.int32).at[kpos].set(
+                        jnp.arange(LB, dtype=jnp.int32), mode="drop")
+                    front = {k: c["lvl"][k][kidx] for k in c["lvl"]}
+                    inrange = jnp.arange(LB, dtype=jnp.int32) < n_keep
+                    gids = jnp.where(inrange, gids_all[kidx], -1)
+                    fmask = inrange
+                    n_front = n_keep
+                else:
+                    front = c["lvl"]
+                    gids = gids_all
+                    fmask = con & validrow
+                    n_front = n_lvl
+                new_c = dict(c, front=front, lvl=c["front"],
+                             fmask=fmask, n_front=n_front, gids=gids,
+                             g_off=c["g_off"] + total,
+                             n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
+                             famx=jnp.zeros_like(c["famx"]),
+                             lrow=jnp.full_like(c["lrow"], -1),
+                             trip_base=jnp.int32(-1),
+                             base=jnp.int32(0))
+                return new_c, opar, olane, ost, oinv
+
+            def abandon(op):
+                # whole-level journal rollback on every shard (the
+                # burst never spills mid-level, so the journal is the
+                # exact record of this level's inserts — the per-level
+                # path replays the level from the intact frontier)
+                c, opar, olane, ost, oinv = op
+                cidx = jnp.where(validrow, c["jslot"], VB)
+                vis = tuple(
+                    c["vis"][w].at[cidx].set(U32MAX, mode="drop")
+                    for w in range(self.W))
+                new_c = dict(c, vis=vis,
+                             n_lvl=jnp.int32(0), n_gen=jnp.int32(0),
+                             ovf=jnp.bool_(False),
+                             fovf=jnp.bool_(False),
+                             sovf=jnp.bool_(False),
+                             hovf=jnp.bool_(False),
+                             famx=jnp.zeros_like(c["famx"]),
+                             lrow=jnp.full_like(c["lrow"], -1),
+                             trip_base=jnp.int32(-1),
+                             base=jnp.int32(0))
+                return new_c, opar, olane, ost, oinv
+
+            c2, opar, olane, ost, oinv = lax.cond(
+                bad, abandon, commit,
+                (c, st["opar"], st["olane"], st["ost"], st["oinv"]))
+            row = jnp.where(
+                bad, jnp.zeros((self._BS_N,), jnp.int32),
+                jnp.stack([n_lvl, n_viol, faults, n_expand, gen_l,
+                           jnp.int32(0), jnp.int32(0), jnp.int32(0)]))
+            new = dict(st, c=c2, opar=opar, olane=olane, ost=ost,
+                       oinv=oinv)
+            new["stats"] = lax.dynamic_update_slice(
+                st["stats"], row[None], (li, 0))
+            new["li"] = li + (~bad).astype(jnp.int32)
+            new["bail"] = st["bail"] | bad
+            new["viol"] = st["viol"] | (~bad & viol_g)
+            new["done"] = st["done"] + jnp.where(bad, 0, total)
+            return new
+
+        st = lax.while_loop(cond, body, st)
+        meta = jnp.stack([st["li"], st["bail"].astype(jnp.int32),
+                          st["c"]["n_front"],
+                          st["viol"].astype(jnp.int32), st["done"],
+                          jnp.int32(0), jnp.int32(0), jnp.int32(0)])
+        stats = jnp.concatenate([st["stats"], meta[None]], axis=0)
+        sg = jax.lax.all_gather(stats, "d")     # [D, L_MAX+1, NS]
+        return (jax.tree_util.tree_map(lambda x: x[None], st["c"]),
+                dict(stats=sg, par=st["opar"][None],
+                     lane=st["olane"][None],
+                     st={k: v[None] for k, v in st["ost"].items()},
+                     inv=st["oinv"][None]))
+
+    # -----------------------------------------------------------------
 
     def _fresh_sharded_carry(self):
         D, LB, VB, FC = self.D, self.LB, self.VB, self.FC
@@ -678,9 +890,11 @@ class ShardedEngine(Engine):
             carry, out = self._level_jit(carry, self.FAM_CAPS)
             return carry, out, np.asarray(out["scal"])  # [D, 10+n_fams]
 
-        def grow_table_if_needed(carry):
+        def grow_table_if_needed(carry, min_add=0):
             # pessimistic per-shard load bound, checked between levels
-            need = int(n_vis.max()) + self.LB
+            # (min_add: a burst can admit up to burst_levels ring-widths
+            # per shard before its next host sync)
+            need = int(n_vis.max()) + max(self.LB, min_add)
             if need > self._LOAD_MAX * self.VB:
                 while need > self._LOAD_MAX * self.VB:
                     self.VB *= 4
@@ -764,8 +978,119 @@ class ShardedEngine(Engine):
             res.seconds = time.time() - t0
             return res
 
+        # burst_ok: a burst that committed levels then bailed keeps the
+        # bailing level's frontier intact — re-entering would replay
+        # the identical lock-step chunks and bail again (one wasted
+        # shard_map round trip); skip the burst for that one level
+        burst_ok = True
         while n_front and depth < max_depth and \
                 res.distinct_states < max_states:
+            kbd = self._mesh_burst_width()
+            if self.burst and burst_ok and n_front <= kbd:
+                # fused K-level burst: ONE shard_map dispatch + ONE
+                # stats readback for up to burst_levels small levels
+                # (_shard_burst).  nlev == 0 means the first level
+                # bailed — fall through to the per-level path below.
+                t1 = time.time()
+                carry = grow_table_if_needed(
+                    carry, min_add=self.burst_levels * kbd)
+                lv_left = min(self.burst_levels, max_depth - depth)
+                st_cap = max(1, min(max_states - res.distinct_states,
+                                    2 ** 31 - 1))
+                carry, bout = self._burst_mesh_jit(
+                    carry, self.FAM_CAPS, jnp.int32(lv_left),
+                    jnp.int32(st_cap))
+                stats = np.asarray(bout["stats"])  # [D, L_MAX+1, NS]
+                nlev = int(stats[0, -1, 0])
+                bailed = bool(stats[0, -1, 1])
+                res.burst_dispatches += 1
+                res.burst_bailouts += int(bailed)
+                if nlev:
+                    burst_ok = not bailed
+                    d0 = depth
+                    viol_any = bool(stats[0, -1, 3])
+                    par_rows = lane_rows = st_rows = inv_rows = None
+                    if self.store_states or viol_any:
+                        par_rows = dict(local_rows(bout["par"]))
+                        lane_rows = dict(local_rows(bout["lane"]))
+                        st_rows = {k: dict(local_rows(v))
+                                   for k, v in bout["st"].items()}
+                        inv_rows = dict(local_rows(bout["inv"]))
+                    for li in range(nlev):
+                        nl = stats[:, li, 0]
+                        n_lvl = int(nl.sum())
+                        res.distinct_states += n_lvl
+                        res.violations_global += int(
+                            stats[:, li, 1].sum())
+                        res.overflow_faults += int(
+                            stats[:, li, 2].sum())
+                        res.generated_states += int(
+                            stats[:, li, 4].sum())
+                        prefix = np.cumsum(nl) - nl
+                        if self.store_states:
+                            ds = sorted(par_rows)
+                            self._parents.append(np.concatenate(
+                                [par_rows[d][li, :nl[d]] for d in ds]))
+                            self._lanes.append(np.concatenate(
+                                [lane_rows[d][li, :nl[d]]
+                                 for d in ds]))
+                            self._states.append(
+                                {k: np.concatenate(
+                                    [st_rows[k][d][li, :nl[d]]
+                                     for d in ds]) for k in st_rows})
+                            self._arch_segs.append(
+                                [(int(d), int(nl[d])) for d in ds])
+                        if stats[:, li, 1].sum():
+                            for d in sorted(inv_rows):
+                                inv_ok = inv_rows[d]
+                                for j, nm in enumerate(self.inv_names):
+                                    for s in np.nonzero(
+                                            ~inv_ok[li, :nl[d], j])[0]:
+                                        vsv, vh = decode(lay, _take(
+                                            {k: st_rows[k][d][li]
+                                             for k in st_rows}, s))
+                                        res.violations.append(
+                                            Violation(
+                                                nm, n_states +
+                                                int(prefix[d]) +
+                                                int(s),
+                                                state=vsv, hist=vh))
+                        n_states += n_lvl
+                        for d in range(D):
+                            n_vis[d] += nl[d]
+                        if n_lvl == 0 and \
+                                int(stats[:, li, 4].sum()) == 0:
+                            pass   # all-pruned frontier: not a level
+                        else:
+                            depth += 1
+                            # inside the depth gate (as engine/bfs) so
+                            # levels_fused ≡ depth advanced everywhere
+                            res.levels_fused += 1
+                            res.level_sizes.append(
+                                int(stats[:, li, 3].sum()))
+                    if n_states >= 2 ** 31 - 1:
+                        raise RuntimeError(
+                            "state-id space exhausted (2^31 ids): run "
+                            "exceeds the engine's int32 global-id "
+                            "width")
+                    n_front = int(stats[:, -1, 2].max())
+                    # fire if ANY multiple of checkpoint_every was
+                    # crossed by the burst's multi-level depth jump
+                    every = max(1, checkpoint_every)
+                    if checkpoint_path is not None and \
+                            depth // every > d0 // every:
+                        self._save_checkpoint(checkpoint_path, carry,
+                                              res, depth, n_states,
+                                              n_vis, n_front)
+                    if stop_on_violation and res.violations_global:
+                        break
+                    if verbose:
+                        print(f"burst: {nlev} levels to depth {depth} "
+                              f"(total {res.distinct_states}), "
+                              f"frontier(max/dev) {n_front}, "
+                              f"{time.time() - t1:.2f}s")
+                    continue
+            burst_ok = True        # re-arm after a per-level level
             depth += 1
             carry = grow_table_if_needed(carry)
             while True:

@@ -113,11 +113,17 @@ class SpilledShardedEngine(ShardedEngine):
         self.LB = self._round_lb(max(kw.get("lcap", 1 << 14) // self.D,
                                      2 * self.D * self.SC))
         self._step_atomic = True      # read at first trace of the step
+        # in-burst level commits compact pruned rows out of the next
+        # frontier (parallel/mesh commit note): this engine's host path
+        # drops them before re-upload, and the window packing — hence
+        # row order and gid assignment — must match it exactly
+        self._burst_compact_frontier = True
         self.mid_level_spills = 0     # diagnostics: ovf-trip spills
         self._sseg_jit = jax.jit(self._spill_seg_call,
                                  donate_argnums=0, static_argnums=1)
         self._mslice_cache = {}
         self._mpaste_cache = {}
+        self._bfront_cache = {}        # post-burst frontier fetch jits
 
     # -- device programs ----------------------------------------------
 
@@ -410,8 +416,28 @@ class SpilledShardedEngine(ShardedEngine):
             res.seconds = time.time() - t0
             return res
 
+        # burst_ok: a burst that committed levels then bailed keeps the
+        # bailing level's frontier intact — re-entering would replay
+        # the identical chunks and bail again (one wasted round trip),
+        # so skip the burst for that level; the segment driver re-arms
+        burst_ok = True
         while any(frontier) and depth < max_depth and \
                 res.distinct_states < max_states:
+            if (self.burst and burst_ok and not self.host_table and
+                    max(sum(int(g.shape[0]) for _r, g in q)
+                        for q in frontier) <= self._mesh_burst_width()):
+                (carry, frontier, depth, n_states, n_vis,
+                 fused, bailed) = self._burst_mesh_levels(
+                    carry, frontier, res, depth, n_states, n_vis,
+                    max_depth, max_states, verbose)
+                if fused:
+                    burst_ok = not bailed
+                    if stop_on_violation and res.violations:
+                        break
+                    continue
+                # first level bailed: the segment driver (with its
+                # growth machinery) runs it below
+            burst_ok = True        # re-arm after a per-level level
             depth += 1
             SEGB = self.LB             # per-device segment rows
             t1 = time.time()
@@ -592,10 +618,152 @@ class SpilledShardedEngine(ShardedEngine):
                      lrow=jnp.full((D, self.VB), -1, jnp.int32))
         return carry, np.array([k.shape[0] for k in fk], np.int64)
 
+    # -- fused multi-level burst --------------------------------------
+    # While every device's frontier fits the burst ring and the
+    # host-table sweep is not in play (host_table sweeps every level),
+    # whole levels run inside ONE shard_map program (_shard_burst,
+    # parallel/mesh) instead of the upload/window/fetch round trips of
+    # the segment driver.  With no mid-level spill possible inside a
+    # burst (any overflow bails the level), the stage-2
+    # content-canonical epoch covers the whole level and the gid
+    # assignment (device-major arithmetic in-loop) coincides exactly
+    # with this engine's (event, device) harvest order — so counts,
+    # archives and traces are bit-identical to the un-bursted path.
+    # -----------------------------------------------------------------
+
+    def _burst_mesh_levels(self, carry, frontier, res, depth, n_states,
+                           n_vis, max_depth, max_states, verbose):
+        """One fused K-level device call on tiny per-device frontiers.
+        Returns (carry, frontier, depth, n_states, n_vis, fused,
+        bailed) — fused=False means the first level bailed and the
+        segment driver must run it (host frontier blocks left
+        untouched); bailed=True means the call ended in a bail (even
+        after committing levels), so re-entering the burst on the
+        unchanged frontier would deterministically bail again."""
+        t1 = time.time()
+        lay = self.lay
+        D = self.D
+        kbd = self._mesh_burst_width()
+        seg = []
+        for q in frontier:
+            if q:
+                keys = q[0][0].keys()
+                seg.append((
+                    {k: np.concatenate([r[k] for r, _g in q])
+                     for k in keys},
+                    np.concatenate([g for _r, g in q])))
+            else:
+                seg.append(None)
+        carry = self._sgrow_table_if_needed(
+            carry, n_vis, min_add=self.burst_levels * kbd)
+        carry = self._upload_seg(carry, seg)
+        # the burst's in-loop gid refresh is device-major arithmetic
+        # from g_off; seed it at the next id this engine would assign
+        carry["g_off"] = jnp.full((D,), n_states, jnp.int32)
+        lv_left = min(self.burst_levels, max_depth - depth)
+        st_cap = max(1, min(max_states - res.distinct_states,
+                            2 ** 31 - 1))
+        carry, bout = self._burst_mesh_jit(
+            carry, self.FAM_CAPS, jnp.int32(lv_left),
+            jnp.int32(st_cap))
+        stats = np.asarray(bout["stats"])       # [D, L_MAX+1, NS]
+        nlev = int(stats[0, -1, 0])
+        bailed = bool(stats[0, -1, 1])
+        res.burst_dispatches += 1
+        res.burst_bailouts += int(bailed)
+        if nlev == 0:
+            return (carry, frontier, depth, n_states, n_vis, False,
+                    bailed)
+        viol_any = bool(stats[0, -1, 3])
+        par_h = lane_h = st_h = inv_h = None
+        if self.store_states or viol_any:
+            par_h = np.asarray(bout["par"])     # [D, L_MAX, kbd]
+            lane_h = np.asarray(bout["lane"])
+            st_h = {k: np.asarray(v) for k, v in bout["st"].items()}
+            inv_h = np.asarray(bout["inv"])     # [D, L_MAX, kbd, n_inv]
+        for li in range(nlev):
+            nl = stats[:, li, 0]
+            n_lvl = int(nl.sum())
+            n_genl = int(stats[:, li, 4].sum())
+            res.distinct_states += n_lvl
+            res.generated_states += n_genl
+            res.overflow_faults += int(stats[:, li, 2].sum())
+            res.violations_global += int(stats[:, li, 1].sum())
+            prefix = np.cumsum(nl) - nl
+            for d in range(D):
+                if not nl[d]:
+                    continue
+                if self.store_states:
+                    # archive part in gid order (device-major per
+                    # level — exactly harvest_blocks' order)
+                    self._cur_parts.append(dict(
+                        n=int(nl[d]),
+                        lpar=par_h[d, li, :nl[d]].copy(),
+                        llane=lane_h[d, li, :nl[d]].copy(),
+                        rows_major={k: st_h[k][d, li, :nl[d]].copy()
+                                    for k in st_h}))
+                if stats[d, li, 1]:
+                    inv_ok = inv_h[d, li, :nl[d]]
+                    for s, j in zip(*np.nonzero(~inv_ok)):
+                        vsv, vh = decode(lay, {
+                            k: np.asarray(st_h[k][d, li, s])
+                            for k in st_h})
+                        res.violations.append(Violation(
+                            self.inv_names[j],
+                            n_states + int(prefix[d]) + int(s),
+                            state=vsv, hist=vh))
+            self._flush_level_parts()
+            if n_lvl or n_genl:
+                depth += 1
+                # inside the depth gate (as engine/bfs) so
+                # levels_fused ≡ depth advanced everywhere
+                res.levels_fused += 1
+                res.level_sizes.append(int(stats[:, li, 3].sum()))
+            n_states += n_lvl
+            for d in range(D):
+                n_vis[d] += nl[d]
+        if n_states >= 2 ** 31 - 1:
+            raise RuntimeError("state-id space exhausted (2^31 ids)")
+        # rebuild the per-device host frontier from the device shards
+        # (pruned rows drop here — prune-not-expand stays host-side
+        # outside the burst)
+        nf = stats[:, -1, 2]
+        frontier = [[] for _ in range(D)]
+        if int(nf.max()) > 0:
+            nq = SpillEngine._quantize(int(nf.max()), self.LB,
+                                       floor=1 << 8)
+            fn = self._bfront_cache.get(nq)
+            if fn is None:
+                def impl(front, gids, fmask, nq=nq):
+                    return ({k: lax.slice_in_dim(v, 0, nq, axis=1)
+                             for k, v in front.items()},
+                            lax.slice_in_dim(gids, 0, nq, axis=1),
+                            lax.slice_in_dim(fmask, 0, nq, axis=1))
+                fn = self._bfront_cache[nq] = jax.jit(impl)
+            rows, gids, fmask = jax.tree_util.tree_map(
+                np.asarray,
+                fn(carry["front"], carry["gids"], carry["fmask"]))
+            for d in range(D):
+                n = int(nf[d])
+                if not n:
+                    continue
+                keep = np.nonzero(fmask[d, :n])[0]
+                if len(keep):
+                    frontier[d].append((
+                        {k: np.ascontiguousarray(v[d][keep])
+                         for k, v in rows.items()},
+                        gids[d][keep].astype(np.int32)))
+        if verbose:
+            print(f"burst: {nlev} levels to depth {depth} "
+                  f"(total {res.distinct_states}), frontier "
+                  f"{sum(int(g.shape[0]) for q in frontier for _r, g in q)}, "
+                  f"{time.time() - t1:.2f}s", flush=True)
+        return carry, frontier, depth, n_states, n_vis, True, bailed
+
     # -- trip handling ------------------------------------------------
 
-    def _sgrow_table_if_needed(self, carry, n_vis):
-        need = int(n_vis.max()) + self.LB
+    def _sgrow_table_if_needed(self, carry, n_vis, min_add=0):
+        need = int(n_vis.max()) + max(self.LB, min_add)
         if need > self._LOAD_MAX * self.VB:
             while need > self._LOAD_MAX * self.VB:
                 self.VB *= 4

@@ -1,15 +1,18 @@
-"""Small-level burst (engine/bfs._burst_impl): up to 16 whole BFS
-levels per device call while the frontier fits one chunk.  The burst
-must be an exact drop-in for the per-level driver — counts, level
-sizes, archives, violations and checkpoints all bit-identical with
-burst on vs off (and vs the Python oracle via the suite's existing
-differential tests, which run with the default burst=True)."""
+"""Fused multi-level burst (engine/bfs._burst_core and its engine
+wrappers): up to burst_levels whole BFS levels per device call while
+the frontier fits the burst ring (_burst_chunks frontier chunks).  The
+burst must be an exact drop-in for the per-level driver in EVERY
+engine — counts, level sizes, archives, violations, traces and
+checkpoints all bit-identical with burst on vs off (and vs the Python
+oracle via the suite's existing differential tests, which run with the
+default burst=True)."""
 
 import numpy as np
 import pytest
 
-from raft_tla_tpu.config import Bounds, ModelConfig
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
 from raft_tla_tpu.engine.bfs import Engine
+from raft_tla_tpu.engine.spill import SpillEngine
 
 MICRO = ModelConfig(
     n_servers=2, init_servers=(0, 1), values=(1,),
@@ -24,6 +27,48 @@ SMALL = ModelConfig(
                        max_client_requests=1),
     constraints=("BoundedTimeouts", "BoundedClientRequests"))
 
+# spill-engine micro (test_spill's shape: NEXT_ASYNC keeps the space
+# small with segment capacities squeezed)
+SPILL_MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+SPILL_KW = dict(chunk=64, seg=1 << 10, vcap=1 << 12, sync_every=2)
+
+# mesh micro (test_sharded's shape: VIEW-only constraints, where
+# count parity is representative-insensitive by construction)
+MESH_MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    max_inflight_override=2, next_family=NEXT_ASYNC, symmetry=False,
+    constraints=("BoundedInFlightMessages", "BoundedRequestVote",
+                 "BoundedLogSize", "BoundedTerms"),
+    invariants=("ElectionSafety", "LogMatching"),
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+
+def _counts_match(a, b):
+    assert a.distinct_states == b.distinct_states
+    assert a.generated_states == b.generated_states
+    assert a.depth == b.depth
+    assert a.level_sizes == b.level_sizes
+    assert a.violations_global == b.violations_global
+
+
+def _archives_match(e_on, e_off):
+    """Archives identical level by level, row by row (same enumeration
+    order => same global ids => identical traces)."""
+    assert len(e_on._parents) == len(e_off._parents)
+    for pa, pb in zip(e_on._parents, e_off._parents):
+        np.testing.assert_array_equal(pa, pb)
+    for la, lb in zip(e_on._lanes, e_off._lanes):
+        np.testing.assert_array_equal(la, lb)
+    for sa, sb in zip(e_on._states, e_off._states):
+        assert sa.keys() == sb.keys()
+        for k in sa:
+            np.testing.assert_array_equal(sa[k], sb[k])
+
 
 # slow-marked (tier-1 budget, PR 2): the burst==driver A/B runs the
 # space twice; the default burst path stays covered by
@@ -35,22 +80,9 @@ def test_burst_matches_per_level_driver(cfg):
     r_on = e_on.check()
     e_off = Engine(cfg, chunk=64, store_states=True, burst=False)
     r_off = e_off.check()
-    assert r_on.distinct_states == r_off.distinct_states
-    assert r_on.generated_states == r_off.generated_states
-    assert r_on.depth == r_off.depth
-    assert r_on.level_sizes == r_off.level_sizes
-    assert r_on.violations_global == r_off.violations_global
-    # archives identical level by level, row by row (same enumeration
-    # order => same global ids => identical traces)
-    assert len(e_on._parents) == len(e_off._parents)
-    for pa, pb in zip(e_on._parents, e_off._parents):
-        np.testing.assert_array_equal(pa, pb)
-    for la, lb in zip(e_on._lanes, e_off._lanes):
-        np.testing.assert_array_equal(la, lb)
-    for sa, sb in zip(e_on._states, e_off._states):
-        assert sa.keys() == sb.keys()
-        for k in sa:
-            np.testing.assert_array_equal(sa[k], sb[k])
+    _counts_match(r_on, r_off)
+    assert r_on.levels_fused > 0     # the fused path actually engaged
+    _archives_match(e_on, e_off)
 
 
 @pytest.mark.slow
@@ -103,3 +135,170 @@ def test_burst_finds_violation():
     assert v_on.invariant == v_off.invariant
     assert v_on.state_id == v_off.state_id
     assert v_on.state == v_off.state
+
+
+def test_burst_rejects_nonpositive_levels():
+    with pytest.raises(ValueError, match="burst_levels"):
+        Engine(MICRO, chunk=64, burst_levels=0)
+    with pytest.raises(ValueError, match="burst_levels"):
+        SpillEngine(SPILL_MICRO, burst_levels=-3)
+
+
+# ---------------------------------------------------------------------
+# fused multi-chunk dispatch (ISSUE 5): the dispatch-floor acceptance
+# pin plus one fast burst≡per-level representative per engine family;
+# the heavier full-space duplicates (archives, traces, checkpoints)
+# are slow-marked per the ROADMAP tier-1 budget rule.
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_burst_dispatch_floor_tiny_levels():
+    """The acceptance shape (config #3's 12 sub-ring early levels):
+    12 levels cost <= 2 burst dispatches instead of 12 per-level round
+    trips, with counts identical to the per-level driver — asserted
+    via the new levels_fused stat."""
+    r_on = Engine(MICRO, chunk=64, store_states=False,
+                  burst=True).check(max_depth=12)
+    r_off = Engine(MICRO, chunk=64, store_states=False,
+                   burst=False).check(max_depth=12)
+    _counts_match(r_on, r_off)
+    assert r_on.depth == 12
+    assert r_on.levels_fused == 12
+    assert r_on.burst_dispatches <= 2
+    assert r_off.levels_fused == 0 and r_off.burst_dispatches == 0
+
+
+def test_spill_burst_matches_segment_driver():
+    """Fast representative: the spill engine's fused path vs its
+    segment driver on a bounded prefix of the space."""
+    r_on = SpillEngine(SPILL_MICRO, store_states=False, burst=True,
+                       **SPILL_KW).check(max_depth=10)
+    r_off = SpillEngine(SPILL_MICRO, store_states=False, burst=False,
+                        **SPILL_KW).check(max_depth=10)
+    _counts_match(r_on, r_off)
+    assert r_on.levels_fused > 0
+    assert r_off.levels_fused == 0
+
+
+@pytest.mark.slow
+def test_sharded_burst_matches_level_driver():
+    """The mesh engines' fused K-level driver vs the per-level
+    shard_map program (8-virtual-device CPU mesh).  Slow-marked: two
+    shard_map compiles per engine cost ~2 min on this container; the
+    default tier-1 representative for the burst is the classic +
+    spill pair above, and the existing default sharded differentials
+    run with burst=True anyway (engaging the fused path against the
+    oracle)."""
+    from raft_tla_tpu.parallel.mesh import ShardedEngine
+    r_on = ShardedEngine(MESH_MICRO, chunk=64, store_states=False,
+                         burst=True).check(max_depth=10)
+    r_off = ShardedEngine(MESH_MICRO, chunk=64, store_states=False,
+                          burst=False).check(max_depth=10)
+    _counts_match(r_on, r_off)
+    assert r_on.levels_fused > 0
+    assert r_off.levels_fused == 0
+
+
+@pytest.mark.slow
+def test_spill_burst_full_parity_archives_traces():
+    """Full space: spill burst on/off counts, archives, violations AND
+    witness-trace replay bit-identical (the burst's gid assignment
+    must coincide with the spilled harvest order exactly)."""
+    e_on = SpillEngine(SPILL_MICRO, store_states=True, burst=True,
+                       **SPILL_KW)
+    r_on = e_on.check()
+    e_off = SpillEngine(SPILL_MICRO, store_states=True, burst=False,
+                        **SPILL_KW)
+    r_off = e_off.check()
+    _counts_match(r_on, r_off)
+    assert r_on.levels_fused > 0
+    _archives_match(e_on, e_off)
+    g = r_on.distinct_states - 1
+    ta, tb = e_on.trace(g), e_off.trace(g)
+    assert [l for l, _ in ta] == [l for l, _ in tb]
+    assert all(sa == sb for (_, sa), (_, sb) in zip(ta, tb))
+
+
+@pytest.mark.slow
+def test_spill_burst_violation_and_checkpoint():
+    """Spill burst: violation states identical on/off, and a
+    checkpoint written mid-run by the bursting engine resumes on the
+    per-level engine to the identical final counts (the checkpoint
+    format is driver-agnostic)."""
+    cfg = SPILL_MICRO.with_(invariants=SPILL_MICRO.invariants +
+                            ("FirstBecomeLeader",))
+    a = SpillEngine(cfg, store_states=False, burst=True,
+                    **SPILL_KW).check(stop_on_violation=True)
+    b = SpillEngine(cfg, store_states=False, burst=False,
+                    **SPILL_KW).check(stop_on_violation=True)
+    assert a.violations and b.violations
+    assert a.violations[0].state_id == b.violations[0].state_id
+    assert a.violations[0].state == b.violations[0].state
+
+    import os
+    import tempfile
+    full = SpillEngine(SPILL_MICRO, store_states=False, burst=True,
+                       **SPILL_KW).check()
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "sb.ckpt")
+        e1 = SpillEngine(SPILL_MICRO, store_states=False, burst=True,
+                         **SPILL_KW)
+        part = e1.check(max_depth=6, checkpoint_path=ckpt,
+                        checkpoint_every=1)
+        assert part.depth == 6
+        e2 = SpillEngine(SPILL_MICRO, store_states=False, burst=False,
+                         **SPILL_KW)
+        resumed = e2.check(resume_from=ckpt)
+    assert resumed.distinct_states == full.distinct_states
+    assert resumed.level_sizes == full.level_sizes
+
+
+@pytest.mark.slow
+def test_sharded_burst_full_parity_archives():
+    """Full space on the virtual mesh: counts, violations and the
+    device-major archives bit-identical burst on/off."""
+    from collections import Counter
+
+    from raft_tla_tpu.parallel.mesh import ShardedEngine
+    e_on = ShardedEngine(MESH_MICRO, chunk=64, store_states=True,
+                         burst=True)
+    r_on = e_on.check()
+    e_off = ShardedEngine(MESH_MICRO, chunk=64, store_states=True,
+                          burst=False)
+    r_off = e_off.check()
+    _counts_match(r_on, r_off)
+    assert r_on.levels_fused > 0
+    assert Counter(v.invariant for v in r_on.violations) == \
+        Counter(v.invariant for v in r_off.violations)
+    assert sorted(v.state_id for v in r_on.violations) == \
+        sorted(v.state_id for v in r_off.violations)
+    _archives_match(e_on, e_off)
+
+
+@pytest.mark.slow
+def test_spill_mesh_burst_full_parity_archives_traces():
+    """Full space, spill-composed mesh: counts, archives, violations
+    and witness-trace replay bit-identical burst on/off — including
+    the in-burst frontier compaction matching the host's
+    prune-not-expand row drop exactly."""
+    from collections import Counter
+
+    from raft_tla_tpu.parallel.spill_mesh import SpilledShardedEngine
+    e_on = SpilledShardedEngine(MESH_MICRO, chunk=64,
+                                store_states=True, lcap=1 << 11,
+                                burst=True)
+    r_on = e_on.check()
+    e_off = SpilledShardedEngine(MESH_MICRO, chunk=64,
+                                 store_states=True, lcap=1 << 11,
+                                 burst=False)
+    r_off = e_off.check()
+    _counts_match(r_on, r_off)
+    assert r_on.levels_fused > 0
+    assert Counter(v.invariant for v in r_on.violations) == \
+        Counter(v.invariant for v in r_off.violations)
+    _archives_match(e_on, e_off)
+    g = r_on.distinct_states - 1
+    ta, tb = e_on.trace(g), e_off.trace(g)
+    assert [l for l, _ in ta] == [l for l, _ in tb]
+    assert all(sa == sb for (_, sa), (_, sb) in zip(ta, tb))

@@ -60,7 +60,7 @@ def main():
     conf_no = int(args.pop(0))
     depth = int(args.pop(0))
     flags = {f: f in args for f in ("--fp128", "--classic", "--native",
-                                    "--host-table")}
+                                    "--host-table", "--no-burst")}
     for f, on in flags.items():
         if on:
             args.remove(f)
@@ -72,7 +72,7 @@ def main():
     opts = dict(zip(args[::2], args[1::2]))
     known = {"--chunk", "--seg", "--vcap", "--budget", "--tag", "--lcap",
              "--fcap", "--ckpt", "--resume", "--ckpt-every",
-             "--partitions", "--part-cap"}
+             "--partitions", "--part-cap", "--burst-levels"}
     bad = set(opts) - known
     if bad or len(args) % 2:
         # fail loud: these depths cannot be cross-checked by any other
@@ -84,6 +84,13 @@ def main():
     chunk = int(opts.get("--chunk", 4096))
     seg = int(opts.get("--seg", 1 << 22))
     vcap = int(opts.get("--vcap", 1 << 26))
+    burst = not flags["--no-burst"]
+    burst_levels = (int(opts["--burst-levels"])
+                    if "--burst-levels" in opts else None)
+    if burst_levels is not None and burst_levels <= 0:
+        raise SystemExit(f"--burst-levels must be positive "
+                         f"(got {burst_levels}); use --no-burst to "
+                         "disable the fused-level path")
     budget = int(opts.get("--budget", 10 ** 9))
     partitions = int(opts.get("--partitions", 4))
     part_cap = int(opts.get("--part-cap", 1 << 16))
@@ -111,11 +118,13 @@ def main():
         eng = Engine(cfg, chunk=chunk, store_states=False, vcap=vcap,
                      lcap=int(opts.get("--lcap", 1 << 21)),
                      fcap=int(opts["--fcap"]) if "--fcap" in opts
-                     else None)
+                     else None,
+                     burst=burst, burst_levels=burst_levels)
     else:
         eng = SpillEngine(cfg, chunk=chunk, store_states=False, seg=seg,
                           vcap=vcap, host_table=host_table,
-                          partitions=partitions, part_cap=part_cap)
+                          partitions=partitions, part_cap=part_cap,
+                          burst=burst, burst_levels=burst_levels)
     t0 = time.time()
     eng.check(max_depth=2)                       # warm the jit caches
     compile_s = time.time() - t0
@@ -157,6 +166,12 @@ def main():
         "overflow_faults": int(r.overflow_faults),
         "chunk": chunk, "seg": seg, "final_vcap": int(eng.VCAP),
         "host_table": host_table,
+        # fused-dispatch telemetry: levels_fused > 0 proves the burst
+        # engaged on the tiny early levels instead of silently bailing
+        "burst": burst,
+        "levels_fused": int(r.levels_fused),
+        "burst_dispatches": int(r.burst_dispatches),
+        "burst_bailouts": int(r.burst_bailouts),
         "resumed_from_checkpoint": bool(resume),
         "expected_fp_collisions": float(
             r.distinct_states ** 2 /

@@ -30,10 +30,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def warm(tag, cfg, **kw):
+    """A depth-2 check per burst mode: the default (burst=True) pass
+    compiles the fused multi-level executable the tiny levels run on;
+    the burst=False pass compiles the per-level step/finalize pair the
+    engine falls back to the moment a level outgrows the burst ring —
+    BOTH are hit by every real run, so both land in the persistent
+    cache here."""
     from raft_tla_tpu.engine.bfs import Engine
     t0 = time.time()
-    eng = Engine(cfg, store_states=False, **kw)
-    eng.check(max_depth=2)
+    for burst in (True, False):
+        eng = Engine(cfg, store_states=False, burst=burst, **kw)
+        eng.check(max_depth=2)
     print(f"{tag}: warmed in {time.time() - t0:.1f}s "
           f"(chunk={eng.chunk} LCAP={eng.LCAP} VCAP={eng.VCAP} "
           f"FCAP={eng.FCAP})", flush=True)
@@ -45,11 +52,16 @@ def warm_spill(tag, cfg, **kw):
     check additionally exercises the partitioned-table executables
     (the sweep membership probe, the cache-reseed insert, and the
     lfp-carrying spill slice), so a post-change deep_run/bench with
-    --host-table doesn't pay their cold compiles mid-run."""
+    --host-table doesn't pay their cold compiles mid-run.  Like
+    warm(), both burst modes run — host-table mode keeps the per-level
+    path (the sweep is due every level), so the burst pass is skipped
+    there."""
     from raft_tla_tpu.engine.spill import SpillEngine
     t0 = time.time()
-    eng = SpillEngine(cfg, store_states=False, **kw)
-    eng.check(max_depth=2)
+    modes = (True, False) if not kw.get("host_table") else (False,)
+    for burst in modes:
+        eng = SpillEngine(cfg, store_states=False, burst=burst, **kw)
+        eng.check(max_depth=2)
     print(f"{tag}: warmed in {time.time() - t0:.1f}s "
           f"(chunk={eng.chunk} SEGL={eng.SEGL} VCAP={eng.VCAP} "
           f"host_table={eng.host_table})", flush=True)
