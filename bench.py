@@ -90,11 +90,18 @@ def _burst_ab(out_path):
     correctness-gated: a mismatch labels the file failed.  On this
     CPU-only container the rows are an honest CPU fallback, exactly as
     BENCH_r06.json labels the sim figures — the dispatch COUNTS are
-    platform-independent; only the seconds are not."""
+    platform-independent; only the seconds are not.
+
+    Round 8: each run carries an obs SpanRecorder, and the row records
+    ``phase_seconds`` (per-span totals: burst_dispatch /
+    level_dispatch / harvest / archive_io / compile) so the A/B delta
+    attributes to dispatch vs compute vs harvest instead of one
+    end-to-end number — the file is the BENCH_r08 round."""
     import jax
 
     from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
     from raft_tla_tpu.engine.bfs import Engine
+    from raft_tla_tpu.obs import Obs, SpanRecorder
 
     micro = ModelConfig(
         n_servers=2, init_servers=(0, 1), values=(1,),
@@ -104,10 +111,13 @@ def _burst_ab(out_path):
     rows, counts = {}, {}
     for label, burst in (("burst_off", False), ("burst_on", True)):
         eng = Engine(micro, chunk=256, store_states=False, burst=burst)
-        eng.check(max_depth=2)                   # warm the jit caches
-        t0 = time.time()
-        r = eng.check()
-        secs = time.time() - t0
+        rec = SpanRecorder()
+        obs = Obs(spans=rec)
+        with obs.span("compile"):
+            eng.check(max_depth=2)               # warm the jit caches
+        t0 = time.perf_counter()
+        r = eng.check(obs=obs)
+        secs = time.perf_counter() - t0
         level_syncs = r.burst_dispatches + (r.depth - r.levels_fused)
         rows[label] = {
             "distinct_states": int(r.distinct_states),
@@ -121,12 +131,19 @@ def _burst_ab(out_path):
             "seconds": round(secs, 2),
             "states_per_sec": round(
                 r.distinct_states / max(secs, 1e-9), 1),
+            # per-phase span totals (obs/spans): the A/B delta
+            # attributes to dispatch vs compute vs harvest
+            "phase_seconds": {nm: t["seconds"]
+                              for nm, t in rec.totals().items()},
+            "phase_counts": {nm: t["count"]
+                             for nm, t in rec.totals().items()},
         }
         counts[label] = (r.distinct_states, r.depth,
                          tuple(r.level_sizes))
     identical = counts["burst_on"] == counts["burst_off"]
     out = {
-        "bench": "fused multi-level dispatch A/B (bench.py)",
+        "bench": "fused multi-level dispatch A/B with per-phase span "
+                 "totals (bench.py, BENCH_r08 round)",
         "platform": jax.default_backend(),
         "honest_label": (
             "CPU-only fallback: this container has no TPU; the "
@@ -202,7 +219,7 @@ def _no_reference_fallback():
             "counts_match_oracle": bool(ok),
             "perf_floor": floor_info}
     burst_ab = _burst_ab(os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), "BENCH_r07.json"))
+        os.path.abspath(__file__)), "BENCH_r08.json"))
     # the burst A/B is correctness-gated like the spill A/B: a
     # burst≡per-level mismatch fails the shared gate, not just the file
     gate_ok = gate_ok and burst_ab["counts_identical"]
@@ -215,7 +232,7 @@ def _no_reference_fallback():
         "detail": {"platform": plat, "correctness_gate": bool(gate_ok),
                    "micro_spill_ab": ab,
                    "burst_ab": {
-                       "written_to": "BENCH_r07.json",
+                       "written_to": "BENCH_r08.json",
                        "counts_identical":
                            burst_ab["counts_identical"],
                        "dispatches_per_level": {
@@ -313,7 +330,7 @@ def main():
     # stays ONE JSON line); a burst≡per-level mismatch fails the
     # headline gate and blocks the floor ratchet below
     burst_ab = _burst_ab(os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_r07.json"))
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r08.json"))
     gate_ok = gate_ok and burst_ab["counts_identical"]
 
     # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------

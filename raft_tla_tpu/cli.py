@@ -154,6 +154,52 @@ def _engine_seed_arrays(cfg, engine_seeds):
     return out
 
 
+_OBS_ARGS = ("ledger", "heartbeat", "trace_timeline", "profile_dir")
+
+
+def _obs_flags_set(args) -> bool:
+    """Flag presence WITHOUT constructing the bundle (building it
+    opens/truncates the ledger and timeline files)."""
+    return any(getattr(args, nm, None) for nm in _OBS_ARGS)
+
+
+def _build_obs(args):
+    """The observability bundle the flags describe (obs package);
+    NULL_OBS when no flag is set."""
+    from .obs import from_flags
+    return from_flags(ledger=getattr(args, "ledger", None),
+                      heartbeat=getattr(args, "heartbeat", None),
+                      timeline=getattr(args, "trace_timeline", None),
+                      profile_dir=getattr(args, "profile_dir", None))
+
+
+def _add_obs_flags(sp):
+    """--ledger/--heartbeat/--trace-timeline/--profile-dir, shared by
+    check and simulate (tools/deep_run.py exposes the same four)."""
+    sp.add_argument("--ledger", default=None, metavar="FILE",
+                    help="append one JSONL record per dispatch (depth, "
+                         "frontier, registry counters, states/sec, "
+                         "RSS, device memory) — flushed per record, so "
+                         "a killed run keeps its telemetry; tail with "
+                         "tools/watch.py")
+    sp.add_argument("--heartbeat", default=None, metavar="FILE",
+                    help="atomically rewrite a small JSON (pid, depth, "
+                         "last-dispatch timestamp, states enqueued) "
+                         "every dispatch so an external watchdog can "
+                         "distinguish a slow level from a dead tunnel")
+    sp.add_argument("--trace-timeline", default=None, metavar="FILE",
+                    help="write the host span timeline (compile / "
+                         "burst_dispatch / harvest / host_sweep / "
+                         "archive_io / checkpoint) as Chrome-trace "
+                         "JSON — load it in Perfetto "
+                         "(https://ui.perfetto.dev)")
+    sp.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="capture an XLA device trace via "
+                         "jax.profiler.trace into DIR; span names ride "
+                         "along as TraceAnnotations so the device "
+                         "trace lines up with --trace-timeline")
+
+
 def cmd_check(args):
     cfg = load_model(args.cfg, bounds=None)
     cfg = _apply_overrides(cfg, args)
@@ -189,12 +235,19 @@ def cmd_check(args):
     if args.engine == "oracle":
         from .models.explore import explore
         import time
-        t0 = time.time()
+        if _obs_flags_set(args):
+            # the oracle has no dispatches to ledger/heartbeat; say so
+            # instead of silently writing nothing (and do NOT build
+            # the bundle — that would touch the files)
+            print("--ledger/--heartbeat/--trace-timeline/--profile-dir "
+                  "instrument the tpu engines; ignored for "
+                  "--engine oracle", file=sys.stderr)
+        t0 = time.perf_counter()
         r = explore(cfg, max_depth=args.max_depth,
                     max_states=args.max_states,
                     stop_on_violation=not args.keep_going,
                     trace_violations=True, seed_states=oracle_seeds)
-        secs = time.time() - t0
+        secs = time.perf_counter() - t0
         viol = [(v.invariant, v.trace) for v in r.violations]
         distinct, depth, gen = r.distinct_states, r.depth, \
             r.generated_states
@@ -233,6 +286,9 @@ def cmd_check(args):
                          store_states=not args.no_store,
                          archive_dir=args.archive_dir,
                          **burst_kw)
+        obs = _build_obs(args)
+        obs.start()
+        done = False
         try:
             r = eng.check(max_depth=args.max_depth,
                           max_states=args.max_states,
@@ -240,7 +296,8 @@ def cmd_check(args):
                           verbose=args.verbose, seed_states=engine_seeds,
                           checkpoint_path=args.checkpoint,
                           checkpoint_every=args.checkpoint_every,
-                          resume_from=args.resume)
+                          resume_from=args.resume, obs=obs)
+            done = True
         except (CheckpointError, FileNotFoundError) as e:
             # only checkpoint load/format problems — a mid-run error
             # after a successful resume propagates with its real trace
@@ -249,6 +306,14 @@ def cmd_check(args):
             print(f"cannot resume from {args.resume}: {e}",
                   file=sys.stderr)
             return 2
+        finally:
+            # the final heartbeat carries the run's reported depth (so
+            # a watchdog sees "finished" with depth == the stats line)
+            if done:
+                obs.finish(depth=int(r.depth),
+                           states=int(r.distinct_states))
+            else:
+                obs.finish(status="failed")
         secs = r.seconds
         viol = []
         for v in r.violations[:args.max_violations]:
@@ -274,33 +339,22 @@ def cmd_check(args):
             print(f"FAULT: {r.overflow_faults} un-representable states "
                   f"(bounds too small for the disabled-constraint space)",
                   file=sys.stderr)
-    out = {
-        "distinct_states": int(distinct),
-        "generated_states": int(gen),
-        "depth": int(depth),
-        "seconds": round(secs, 3),
-        "states_per_sec": round(distinct / max(secs, 1e-9), 1),
-        "dedup_hit_rate": round(1.0 - distinct / max(gen, 1), 4),
-        "violations": len(viol),
-    }
-    if getattr(r, "pin_interior_states", 0):
-        # TLC counts the pinned-prefix interior states; we check them
-        # but seed past them — surface the divergence bound
-        out["pin_interior_states"] = int(r.pin_interior_states)
-    if args.engine != "oracle":
-        # dedup is fingerprint-based (TLC semantics): surface the
-        # expected-collision bound the exhaustiveness claim rests on
-        # (ADVICE r1; SURVEY §7.4 pt 4).  E[collisions] <= n^2 / 2^(b+1)
-        bits = 128 if args.fp128 else 64
-        out["fp_bits"] = bits
-        out["expected_fp_collisions"] = float(
-            distinct * distinct / 2.0 ** (bits + 1))
-        # fused-dispatch telemetry: proves the multi-level burst
-        # engaged (levels_fused > 0) instead of silently bailing every
-        # level (burst_bailouts ~ depth with levels_fused 0)
-        out["levels_fused"] = int(r.levels_fused)
-        out["burst_dispatches"] = int(r.burst_dispatches)
-        out["burst_bailouts"] = int(r.burst_bailouts)
+    # ONE stats assembler (obs.metrics.check_stats) generates the
+    # stdout line and --stats-json from the metrics registry — same
+    # keys as the historical hand-built dict (pinned by
+    # tests/test_obs.py), incl. pin_interior_states only when nonzero
+    # and the fingerprint/burst telemetry only for the tpu engines
+    from .obs.metrics import check_stats
+    if args.engine == "oracle":
+        counters = dict(
+            distinct_states=int(distinct), generated_states=int(gen),
+            depth=int(depth),
+            pin_interior_states=int(
+                getattr(r, "pin_interior_states", 0) or 0))
+        out = check_stats(counters, secs, len(viol))
+    else:
+        out = check_stats(r.metrics.as_dict(), secs, len(viol),
+                          fp_bits=128 if args.fp128 else 64)
     print(json.dumps(out))
     if args.stats_json:
         # oracle runs write the same stats file (minus the
@@ -374,7 +428,7 @@ def cmd_trace(args):
     if args.engine == "oracle":
         import time
         from .models.explore import explore
-        t0 = time.time()
+        t0 = time.perf_counter()
         r = explore(cfg, max_depth=args.max_depth,
                     max_states=args.max_states, stop_on_violation=True,
                     trace_violations=True)
@@ -384,7 +438,7 @@ def cmd_trace(args):
             return 1
         print(f"witness for {args.target} at depth {r.depth} "
               f"({r.distinct_states} states explored, "
-              f"{time.time() - t0:.1f}s):")
+              f"{time.perf_counter() - t0:.1f}s):")
         for step, label in enumerate(r.violations[0].trace):
             print(f"  {step + 1:3d}  {label}")
         if args.emit_seed:
@@ -448,29 +502,26 @@ def cmd_simulate(args):
         eng = ShardedSimEngine(cfg, walkers=args.walkers, **kw)
     else:
         eng = SimEngine(cfg, walkers=args.walkers, **kw)
-    t0 = time.time()
-    r = eng.run(steps=args.steps,
-                steps_per_dispatch=args.steps_per_dispatch,
-                verbose=args.verbose)
-    out = {
-        "target": args.target,
-        "policy": args.policy,
-        "walkers": r.walkers,
-        "steps_dispatched": r.steps_dispatched,
-        "walker_steps": r.walker_steps,
-        "sampled_steps": r.sampled_steps,
-        "walker_steps_per_sec": round(r.walker_steps_per_sec, 1),
-        "restarts": r.restarts,
-        "deadlocks": r.deadlocks,
-        "promotions": r.promotions,
-        "seconds": round(r.seconds, 3),
-        "est_distinct_states": round(r.est_distinct_states, 1),
-        "bloom_saturated": r.bloom_saturated,
-        "bloom_canonical": r.bloom_canonical,
-        "hits": len(r.hits),
-        "platform": jax.default_backend(),
-        "seed": args.seed,
-    }
+    obs = _build_obs(args)
+    obs.start()
+    t0 = time.perf_counter()
+    done = False
+    try:
+        r = eng.run(steps=args.steps,
+                    steps_per_dispatch=args.steps_per_dispatch,
+                    verbose=args.verbose, obs=obs)
+        done = True
+    finally:
+        if done:
+            obs.finish(depth=int(r.steps_dispatched),
+                       states=int(r.walker_steps))
+        else:
+            obs.finish(status="failed")
+    # the ONE simulate stats assembler (obs.metrics.sim_stats) — same
+    # keys as the historical hand-built dict
+    from .obs.metrics import sim_stats
+    out = sim_stats(r, target=args.target, policy=args.policy,
+                    seed=args.seed, platform=jax.default_backend())
     print(json.dumps(out))
     if args.stats_json:
         with open(args.stats_json, "w") as fh:
@@ -482,7 +533,7 @@ def cmd_simulate(args):
     h = eng.decode_hit(r.hits[0])
     print(f"witness for {args.target} at depth {h.depth} "
           f"(walker {h.walker}, {r.walker_steps} walker-steps, "
-          f"{time.time() - t0:.1f}s):")
+          f"{time.perf_counter() - t0:.1f}s):")
     for step, (label, sv) in enumerate(h.trace):
         print(f"  {step:3d}  {label}")
         if args.verbose:
@@ -584,6 +635,7 @@ def main(argv=None):
     pc.add_argument("--stats-json", default=None, metavar="FILE",
                     help="write the run stats JSON (incl. "
                          "levels_fused/burst_bailouts) to FILE")
+    _add_obs_flags(pc)
     pc.add_argument("--no-store", action="store_true",
                     help="do not retain states (no traces; less memory)")
     pc.add_argument("--max-violations", type=int, default=5)
@@ -674,6 +726,7 @@ def main(argv=None):
                          "punctuated exhaustive search)")
     ps.add_argument("--stats-json", default=None, metavar="FILE",
                     help="write the run stats JSON to FILE")
+    _add_obs_flags(ps)
     ps.set_defaults(fn=cmd_simulate)
 
     args = p.parse_args(argv)

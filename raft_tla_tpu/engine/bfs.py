@@ -43,7 +43,7 @@ rehashing into a larger table on device.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -53,6 +53,8 @@ from jax import lax
 
 from ..config import CANDIDATE, ModelConfig
 from ..models.raft import Hist, State, init_state
+from ..obs import NULL_OBS
+from ..obs.metrics import CHECK_COUNTER_KEYS
 from ..ops.codec import (C_GLOBLEN, C_OVERFLOW, decode, encode, narrow,
                          widen)
 from ..ops.kernels import RaftKernels
@@ -114,36 +116,66 @@ class Violation:
     trace: Optional[List[str]] = None
 
 
-@dataclass
 class CheckResult:
-    distinct_states: int
-    generated_states: int
-    depth: int
-    violations: List[Violation] = field(default_factory=list)
-    level_sizes: List[int] = field(default_factory=list)
-    seconds: float = 0.0
-    overflow_faults: int = 0
-    phase_seconds: Dict[str, float] = field(default_factory=dict)
-    # total across the whole mesh — under a multi-controller run the
-    # `violations` list holds only this controller's shards, but this
-    # count (from the replicated scalar matrix) is global
-    violations_global: int = 0
-    # fused-dispatch telemetry (the multi-level burst fast path):
-    # levels committed inside bursts, burst device calls (each is
-    # exactly one host round trip, whether it committed levels or
-    # not), and calls that ended in a bail back to the per-level path
-    # (a call can both commit levels AND bail) — bench/progress lines
-    # read these to prove the burst engaged instead of silently
-    # bailing every level
-    levels_fused: int = 0
-    burst_dispatches: int = 0
-    burst_bailouts: int = 0
-    # punctuated search from cfg prefix pins seeds BFS at the witness
-    # END state (models/golden docstring); TLC also counts the prefix
-    # interior states.  This is the number of distinct interior states
-    # the engine invariant-checked but did NOT count — the upper bound
-    # on the distinct_states divergence from TLC for pinned cfgs.
-    pin_interior_states: int = 0
+    """Run result whose scalar counters live in ONE
+    ``obs.metrics.MetricsRegistry`` (``self.metrics``); the named
+    attributes below are write-through views, so a harvest loop
+    mutating ``res.levels_fused`` IS updating the registry — the
+    ledger, ``--stats-json`` and checkpoint meta all read the same
+    store and cannot drift apart per consumer (the PR-5
+    ``levels_fused`` bug class).
+
+    Counter notes (the registry keys, obs.metrics.CHECK_COUNTER_KEYS):
+
+    - ``violations_global`` — total across the whole mesh; under a
+      multi-controller run the ``violations`` list holds only this
+      controller's shards, but this count (from the replicated scalar
+      matrix) is global.
+    - ``levels_fused`` / ``burst_dispatches`` / ``burst_bailouts`` —
+      fused-dispatch telemetry (the multi-level burst fast path):
+      levels committed inside bursts, burst device calls (each is
+      exactly one host round trip, whether it committed levels or
+      not), and calls that ended in a bail back to the per-level path
+      (a call can both commit levels AND bail) — bench/progress lines
+      read these to prove the burst engaged instead of silently
+      bailing every level.
+    - ``pin_interior_states`` — punctuated search from cfg prefix pins
+      seeds BFS at the witness END state (models/golden docstring);
+      TLC also counts the prefix interior states.  This is the number
+      of distinct interior states the engine invariant-checked but did
+      NOT count — the upper bound on the distinct_states divergence
+      from TLC for pinned cfgs.
+    """
+
+    # the ONE canonical key tuple lives in obs.metrics — aliasing it
+    # (not copying) is what makes a future counter addition a
+    # single-site change
+    _COUNTERS = CHECK_COUNTER_KEYS
+
+    def __init__(self, distinct_states: int = 0,
+                 generated_states: int = 0, depth: int = 0,
+                 violations: Optional[List[Violation]] = None,
+                 level_sizes: Optional[List[int]] = None,
+                 seconds: float = 0.0, overflow_faults: int = 0,
+                 phase_seconds: Optional[Dict[str, float]] = None,
+                 violations_global: int = 0, levels_fused: int = 0,
+                 burst_dispatches: int = 0, burst_bailouts: int = 0,
+                 pin_interior_states: int = 0):
+        from ..obs.metrics import MetricsRegistry
+        init = locals()
+        self.metrics = MetricsRegistry()
+        for nm in self._COUNTERS:
+            self.metrics.register(nm, int(init[nm]))
+        self.violations: List[Violation] = list(violations or [])
+        self.level_sizes: List[int] = list(level_sizes or [])
+        self.seconds = float(seconds)
+        self.phase_seconds: Dict[str, float] = dict(phase_seconds or {})
+
+    def __repr__(self):
+        body = ", ".join(f"{k}={v}"
+                         for k, v in self.metrics.as_dict().items())
+        return (f"CheckResult({body}, seconds={self.seconds:.3f}, "
+                f"violations={len(self.violations)})")
 
     @property
     def states_per_sec(self):
@@ -154,6 +186,15 @@ class CheckResult:
         """Fraction of generated successors that were duplicates —
         TLC's 'distinct vs generated' engine metric (SURVEY §5)."""
         return 1.0 - self.distinct_states / max(self.generated_states, 1)
+
+
+def _metric_view(nm: str) -> property:
+    return property(lambda self: self.metrics.get(nm),
+                    lambda self, v: self.metrics.set(nm, int(v)))
+
+
+for _nm in CheckResult._COUNTERS:
+    setattr(CheckResult, _nm, _metric_view(_nm))
 
 
 def _ceil_log2(n: int) -> int:
@@ -353,6 +394,10 @@ class Engine:
                  archive_dir: Optional[str] = None):
         enable_persistent_compilation_cache()
         self.cfg = cfg
+        # observability bundle (obs/): check() rebinds it per run; the
+        # archive/checkpoint helpers read it so their spans land on the
+        # active run's timeline
+        self._obs = NULL_OBS
         self.chunk = max(16, int(chunk))
         self.store_states = store_states
         # disk-backed per-level trace archives (engine/archive): with
@@ -394,7 +439,7 @@ class Engine:
         # ~1-4x chunk where enabled can exceed 20x chunk on the
         # membership config, so the second compaction cuts the
         # append-side work ~8x (measured 17+21 ms -> 8+11 ms per chunk
-        # at FCAP=2^16 vs 2^13, tools/profile_config3b.py).  A chunk
+        # at FCAP=2^16 vs 2^13, tools/profile.py).  A chunk
         # whose fresh count exceeds OCAP trips oovf and the level
         # replays with OCAP grown (same discipline as FCAP/fam caps).
         self.OCAP = self._round_cap(min(self.FCAP, int(ocap) if ocap
@@ -809,7 +854,7 @@ class Engine:
         # Everything downstream (phase2, narrow, the level append) runs
         # at OCAP width — fresh rows are the dedup survivors, typically
         # ~8x fewer than enabled candidates on wide-grid configs
-        # (tools/profile_config3b.py measured the width halves the
+        # (tools/profile.py measured the width halves the
         # append+phase2 cost even at 8x).
         slot = jnp.arange(FCAP, dtype=jnp.int32)
         lpos = jnp.where(fresh,
@@ -1314,12 +1359,13 @@ class Engine:
             self._arch = DiskArchive(self.archive_dir)
 
     def _archive_level(self, parents, lanes, states_major):
-        if self._arch is not None:
-            self._arch.append_level(parents, lanes, states_major)
-        else:
-            self._parents.append(parents)
-            self._lanes.append(lanes)
-            self._states.append(states_major)
+        with self._obs.span("archive_io"):
+            if self._arch is not None:
+                self._arch.append_level(parents, lanes, states_major)
+            else:
+                self._parents.append(parents)
+                self._lanes.append(lanes)
+                self._states.append(states_major)
 
     def _ckpt_store_args(self):
         """(parents, lanes, states, extra-meta) for ckpt_write: a disk
@@ -1363,7 +1409,7 @@ class Engine:
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
-              verbose: bool = False) -> CheckResult:
+              verbose: bool = False, obs=None) -> CheckResult:
         """seed_states entries are (State, Hist) pairs or raw SoA dicts
         (the latter preserve feature lanes exactly — engine-emitted
         seeds; punctuated search, SURVEY §2.9).
@@ -1371,8 +1417,13 @@ class Engine:
         checkpoint_path — write a checkpoint there every
         ``checkpoint_every`` levels; resume_from — continue a prior
         checkpointed run (final counts are identical to an
-        uninterrupted run; levels are never half-resumed)."""
-        t0 = time.time()
+        uninterrupted run; levels are never half-resumed).
+
+        obs — an ``obs.Obs`` bundle (spans / JSONL ledger / heartbeat /
+        profiler hooks); every dispatch writes one ledger record and
+        one heartbeat rewrite, so a killed run keeps its telemetry."""
+        obs = self._obs = obs if obs is not None else NULL_OBS
+        t0 = time.perf_counter()
         lay = self.lay
 
         if resume_from is not None:
@@ -1491,7 +1542,7 @@ class Engine:
             carry, out, scal = run_finalize(carry)
             n_front = harvest(carry, out, scal)
         if stop_on_violation and res.violations:
-            res.seconds = time.time() - t0
+            res.seconds = time.perf_counter() - t0
             return res
 
         # burst_ok gates the speculative burst entry: a burst that
@@ -1510,16 +1561,20 @@ class Engine:
                 # the very first level bailed on an overflow — fall
                 # through and let the per-level path (with its growth
                 # machinery) run that level.
-                t1 = time.time()
-                carry = grow_table_if_needed(
-                    carry, min_add=self.burst_levels * self._burst_width())
-                lv_left = min(self.burst_levels, max_depth - depth)
-                st_cap = max(1, min(max_states - res.distinct_states,
-                                    2 ** 31 - 1))
-                carry, bout = self._burst_jit(
-                    carry, self.FAM_CAPS, jnp.int32(lv_left),
-                    jnp.int32(st_cap))
-                stats = np.asarray(bout["stats"])  # the ONE burst sync
+                t1 = time.perf_counter()
+                with obs.span("burst_dispatch"):
+                    carry = grow_table_if_needed(
+                        carry,
+                        min_add=self.burst_levels * self._burst_width())
+                    lv_left = min(self.burst_levels, max_depth - depth)
+                    st_cap = max(1,
+                                 min(max_states - res.distinct_states,
+                                     2 ** 31 - 1))
+                    carry, bout = self._burst_jit(
+                        carry, self.FAM_CAPS, jnp.int32(lv_left),
+                        jnp.int32(st_cap))
+                    stats = np.asarray(bout["stats"])  # the ONE burst
+                    # sync
                 nlev = int(stats[-1, 0])
                 bailed = bool(stats[-1, 1])
                 res.burst_dispatches += 1
@@ -1529,56 +1584,60 @@ class Engine:
                     d0 = depth
                     n_front = int(stats[-1, 2])
                     viol_any = bool(stats[-1, 3])
-                    par_h = lane_h = st_h = inv_h = None
-                    if self.store_states or viol_any:
-                        par_h = np.asarray(bout["par"])
-                        lane_h = np.asarray(bout["lane"])
-                        st_h = {k: np.asarray(v)
-                                for k, v in bout["st"].items()}
-                        inv_h = np.asarray(bout["inv"])
-                    for li in range(nlev):
-                        n_lvl, n_viol, faults, n_expand, n_genl = (
-                            int(x) for x in stats[li, :5])
-                        res.distinct_states += n_lvl
-                        res.generated_states += n_genl
-                        res.overflow_faults += faults
-                        res.violations_global += n_viol
-                        if self.store_states:
-                            self._archive_level(
-                                par_h[li, :n_lvl].copy(),
-                                lane_h[li, :n_lvl].copy(),
-                                {k: np.moveaxis(
-                                    v[..., li, :n_lvl], -1, 0).copy()
-                                 for k, v in st_h.items()})
-                        if n_viol:
-                            rows = {k: np.moveaxis(
-                                        v[..., li, :n_lvl], -1, 0)
-                                    for k, v in st_h.items()}
-                            for j, nm in enumerate(self.inv_names):
-                                for s in np.nonzero(
-                                        ~inv_h[j, li, :n_lvl])[0]:
-                                    vsv, vh = decode(self.lay,
-                                                     _take(rows, s))
-                                    res.violations.append(Violation(
-                                        nm, n_states + int(s),
-                                        state=vsv, hist=vh))
-                        if n_lvl == 0 and n_genl == 0:
-                            pass     # all-pruned frontier: not a level
-                        else:
-                            depth += 1
-                            # counted HERE, not as the raw loop-trip
-                            # count, so levels_fused ≡ depth advanced
-                            # and bench's (depth - levels_fused) is the
-                            # per-level-driver level count exactly
-                            res.levels_fused += 1
-                            res.level_sizes.append(n_expand)
-                        n_states += n_lvl
-                        n_vis += n_lvl
+                    with obs.span("harvest"):
+                        par_h = lane_h = st_h = inv_h = None
+                        if self.store_states or viol_any:
+                            par_h = np.asarray(bout["par"])
+                            lane_h = np.asarray(bout["lane"])
+                            st_h = {k: np.asarray(v)
+                                    for k, v in bout["st"].items()}
+                            inv_h = np.asarray(bout["inv"])
+                        for li in range(nlev):
+                            n_lvl, n_viol, faults, n_expand, n_genl = (
+                                int(x) for x in stats[li, :5])
+                            res.distinct_states += n_lvl
+                            res.generated_states += n_genl
+                            res.overflow_faults += faults
+                            res.violations_global += n_viol
+                            if self.store_states:
+                                self._archive_level(
+                                    par_h[li, :n_lvl].copy(),
+                                    lane_h[li, :n_lvl].copy(),
+                                    {k: np.moveaxis(
+                                        v[..., li, :n_lvl],
+                                        -1, 0).copy()
+                                     for k, v in st_h.items()})
+                            if n_viol:
+                                rows = {k: np.moveaxis(
+                                            v[..., li, :n_lvl], -1, 0)
+                                        for k, v in st_h.items()}
+                                for j, nm in enumerate(self.inv_names):
+                                    for s in np.nonzero(
+                                            ~inv_h[j, li, :n_lvl])[0]:
+                                        vsv, vh = decode(self.lay,
+                                                         _take(rows, s))
+                                        res.violations.append(Violation(
+                                            nm, n_states + int(s),
+                                            state=vsv, hist=vh))
+                            if n_lvl == 0 and n_genl == 0:
+                                pass     # all-pruned frontier: not a
+                                # level
+                            else:
+                                depth += 1
+                                # counted HERE, not as the raw
+                                # loop-trip count, so levels_fused ≡
+                                # depth advanced and bench's
+                                # (depth - levels_fused) is the
+                                # per-level-driver level count exactly
+                                res.levels_fused += 1
+                                res.level_sizes.append(n_expand)
+                            n_states += n_lvl
+                            n_vis += n_lvl
                     if n_states >= 2 ** 31 - 1:
                         raise RuntimeError(
                             "state-id space exhausted (2^31 ids): run "
                             "exceeds the engine's int32 global-id width")
-                    t_dev += time.time() - t1
+                    t_dev += time.perf_counter() - t1
                     # fire if ANY multiple of checkpoint_every was
                     # crossed this burst (a multi-level depth jump can
                     # step over every exact multiple)
@@ -1588,17 +1647,22 @@ class Engine:
                         self._save_checkpoint(checkpoint_path, carry,
                                               res, depth, n_states,
                                               n_vis, n_front)
+                    obs.dispatch(kind="burst", depth=depth,
+                                 frontier=n_front,
+                                 metrics=res.metrics.as_dict())
                     if stop_on_violation and res.violations:
                         break
                     if verbose:
                         print(f"burst: {nlev} levels to depth {depth} "
                               f"(total {res.distinct_states}), "
                               f"frontier {n_front}, "
-                              f"{time.time() - t1:.2f}s")
+                              f"{time.perf_counter() - t1:.2f}s")
                     continue
             burst_ok = True        # re-arm after a per-level level
             depth += 1
-            t1 = time.time()
+            t1 = time.perf_counter()
+            _lvl_span = obs.span("level_dispatch")
+            _lvl_span.__enter__()
             carry = grow_table_if_needed(carry)
             while True:
                 n_chunks = (n_front + self.chunk - 1) // self.chunk
@@ -1672,7 +1736,9 @@ class Engine:
                     # before replaying (a full table would spin the
                     # probe walk to its round budget)
                     carry = grow_table_if_needed(carry)
-            n_front = harvest(carry, out, scal)
+            _lvl_span.__exit__(None, None, None)
+            with obs.span("harvest"):
+                n_front = harvest(carry, out, scal)
             # per-family enabled maxima ride the scal tail every level;
             # keep the run-wide max as cap-sizing diagnostics
             # (tools/tune_config3.py reads this to pre-size FAM_CAPS)
@@ -1689,20 +1755,22 @@ class Engine:
             else:
                 # post-constraint frontier size, the oracle's metric
                 res.level_sizes.append(scal[7])
-            t_dev += time.time() - t1
+            t_dev += time.perf_counter() - t1
             if checkpoint_path is not None and \
                     depth % max(1, checkpoint_every) == 0:
                 self._save_checkpoint(checkpoint_path, carry, res,
                                       depth, n_states, n_vis, n_front)
+            obs.dispatch(kind="level", depth=depth, frontier=n_front,
+                         metrics=res.metrics.as_dict())
             if stop_on_violation and res.violations:
                 break
             if verbose:
                 print(f"depth {depth}: +{scal[0]} states "
                       f"(total {res.distinct_states}), "
-                      f"frontier {n_front}, "
-                      f"{n_chunks} chunks in {time.time() - t1:.2f}s")
+                      f"frontier {n_front}, {n_chunks} chunks in "
+                      f"{time.perf_counter() - t1:.2f}s")
         res.depth = depth
-        res.seconds = time.time() - t0
+        res.seconds = time.perf_counter() - t0
         res.phase_seconds["device_levels"] = t_dev
         return res
 
@@ -1741,14 +1809,17 @@ class Engine:
 
     def _save_checkpoint(self, path, carry, res, depth, n_states,
                          n_vis, n_front):
-        parents, lanes, states, arch_meta = self._ckpt_store_args()
-        ckpt_write(path, carry, self.store_states, parents,
-                   lanes, states, res, dict(
-                       depth=depth, n_states=n_states, n_vis=n_vis,
-                       n_front=n_front, LCAP=self.LCAP, VCAP=self.VCAP,
-                       FCAP=self.FCAP, OCAP=self.OCAP,
-                       fam_caps=list(self.FAM_CAPS), **arch_meta,
-                       layout=2, chunk=self.chunk, cfg=repr(self.cfg)))
+        with self._obs.span("checkpoint"):
+            parents, lanes, states, arch_meta = self._ckpt_store_args()
+            ckpt_write(path, carry, self.store_states, parents,
+                       lanes, states, res, dict(
+                           depth=depth, n_states=n_states, n_vis=n_vis,
+                           n_front=n_front, LCAP=self.LCAP,
+                           VCAP=self.VCAP, FCAP=self.FCAP,
+                           OCAP=self.OCAP,
+                           fam_caps=list(self.FAM_CAPS), **arch_meta,
+                           layout=2, chunk=self.chunk,
+                           cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,

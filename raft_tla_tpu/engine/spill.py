@@ -64,6 +64,7 @@ from jax import lax
 from ..config import ModelConfig
 from ..models.raft import init_state
 from ..ops.codec import C_OVERFLOW, decode, encode, narrow, widen
+from ..obs import NULL_OBS
 from .bfs import (CheckResult, CheckpointError, Engine, U32MAX,
                   _HOME_SALT, Violation, ckpt_read, ckpt_result,
                   ckpt_write)
@@ -553,6 +554,10 @@ class SpillEngine(Engine):
         host link while the device probes (the spill engine's
         double-buffering discipline) — then commit the fresh keys into
         the host partitions.  Returns keep = not-seen-before [N]."""
+        with self._obs.span("host_sweep"):
+            return self._sweep_level_keys_impl(keys)
+
+    def _sweep_level_keys_impl(self, keys: np.ndarray) -> np.ndarray:
         n_all = keys.shape[0]
         keep = np.ones(n_all, bool)
         if n_all == 0:
@@ -665,36 +670,38 @@ class SpillEngine(Engine):
         instead; bailed=True means the call ended in a bail (even
         after committing levels), so re-entering the burst on the
         unchanged frontier would deterministically bail again."""
-        t1 = time.time()
+        t1 = time.perf_counter()
         lay = self.lay
-        KB = self._burst_width()
-        n_front = sum(int(g.shape[0]) for _r, g in frontier_blocks)
-        rows_cat, gids_cat = self._cat_seg(
-            [r for r, _g in frontier_blocks],
-            [g for _r, g in frontier_blocks])
-        one = narrow(lay, encode(lay, *init_state(self.cfg)))
-        fr_np = {k: np.zeros(v.shape + (KB,), v.dtype)
-                 for k, v in one.items()}
-        for k in fr_np:
-            fr_np[k][..., :n_front] = rows_cat[k]
-        gd_np = np.full((KB,), -1, np.int32)
-        gd_np[:n_front] = gids_cat
-        fm_np = np.zeros((KB,), bool)
-        fm_np[:n_front] = True
-        carry = self._grow_table_if_needed(
-            carry, n_vis, min_add=self.burst_levels * KB)
-        lv_left = min(self.burst_levels, max_depth - depth)
-        st_cap = max(1, min(max_states - res.distinct_states,
-                            2 ** 31 - 1))
-        vis, claims, frd, fmd, gdd, _nfd, out = self._spill_burst_jit(
-            carry["vis"], carry["claims"],
-            {k: jnp.asarray(v) for k, v in fr_np.items()},
-            jnp.asarray(fm_np), jnp.asarray(gd_np),
-            jnp.int32(n_front), jnp.int32(n_states),
-            self.FAM_CAPS, self.FCAP,
-            jnp.int32(lv_left), jnp.int32(st_cap))
-        carry = dict(carry, vis=vis, claims=claims)
-        stats = np.asarray(out["stats"])       # the ONE burst sync
+        with self._obs.span("burst_dispatch"):
+            KB = self._burst_width()
+            n_front = sum(int(g.shape[0]) for _r, g in frontier_blocks)
+            rows_cat, gids_cat = self._cat_seg(
+                [r for r, _g in frontier_blocks],
+                [g for _r, g in frontier_blocks])
+            one = narrow(lay, encode(lay, *init_state(self.cfg)))
+            fr_np = {k: np.zeros(v.shape + (KB,), v.dtype)
+                     for k, v in one.items()}
+            for k in fr_np:
+                fr_np[k][..., :n_front] = rows_cat[k]
+            gd_np = np.full((KB,), -1, np.int32)
+            gd_np[:n_front] = gids_cat
+            fm_np = np.zeros((KB,), bool)
+            fm_np[:n_front] = True
+            carry = self._grow_table_if_needed(
+                carry, n_vis, min_add=self.burst_levels * KB)
+            lv_left = min(self.burst_levels, max_depth - depth)
+            st_cap = max(1, min(max_states - res.distinct_states,
+                                2 ** 31 - 1))
+            vis, claims, frd, fmd, gdd, _nfd, out = \
+                self._spill_burst_jit(
+                    carry["vis"], carry["claims"],
+                    {k: jnp.asarray(v) for k, v in fr_np.items()},
+                    jnp.asarray(fm_np), jnp.asarray(gd_np),
+                    jnp.int32(n_front), jnp.int32(n_states),
+                    self.FAM_CAPS, self.FCAP,
+                    jnp.int32(lv_left), jnp.int32(st_cap))
+            carry = dict(carry, vis=vis, claims=claims)
+            stats = np.asarray(out["stats"])      # the ONE burst sync
         nlev = int(stats[-1, 0])
         bailed = bool(stats[-1, 1])
         res.burst_dispatches += 1
@@ -703,49 +710,51 @@ class SpillEngine(Engine):
             return (carry, frontier_blocks, depth, n_states, n_vis,
                     False, bailed)
         viol_any = bool(stats[-1, 3])
-        par_h = lane_h = st_h = inv_h = None
-        if self.store_states or viol_any:
-            par_h = np.asarray(out["par"])
-            lane_h = np.asarray(out["lane"])
-            st_h = {k: np.asarray(v) for k, v in out["st"].items()}
-            inv_h = np.asarray(out["inv"])
-        for li in range(nlev):
-            n_lvl, n_viol, faults, n_expand, n_genl = (
-                int(x) for x in stats[li, :5])
-            res.distinct_states += n_lvl
-            res.generated_states += n_genl
-            res.overflow_faults += faults
-            res.violations_global += n_viol
-            if self.store_states and n_lvl:
-                # n_lvl == 0 appends nothing: the spill archive's
-                # gid->row mapping is cumulative, not per-level
-                # (flush_archives skips empty levels the same way)
-                self._archive_level(
-                    par_h[li, :n_lvl].copy(),
-                    lane_h[li, :n_lvl].copy(),
-                    {k: np.moveaxis(v[..., li, :n_lvl], -1, 0).copy()
-                     for k, v in st_h.items()})
-            if n_viol:
-                rows = {k: np.moveaxis(v[..., li, :n_lvl], -1, 0)
-                        for k, v in st_h.items()}
-                for j, nm in enumerate(self.inv_names):
-                    for s in np.nonzero(~inv_h[j, li, :n_lvl])[0]:
-                        vsv, vh = decode(
-                            lay, {kk: np.asarray(rows[kk][s])
-                                  for kk in rows})
-                        res.violations.append(Violation(
-                            nm, n_states + int(s), state=vsv,
-                            hist=vh))
-            if n_lvl or n_genl:
-                depth += 1
-                # counted inside the depth gate (engine/bfs does the
-                # same) so levels_fused ≡ depth advanced in every
-                # engine and (depth - levels_fused) is exactly the
-                # per-level-driver level count
-                res.levels_fused += 1
-                res.level_sizes.append(n_expand)
-            n_states += n_lvl
-            n_vis += n_lvl
+        with self._obs.span("harvest"):
+            par_h = lane_h = st_h = inv_h = None
+            if self.store_states or viol_any:
+                par_h = np.asarray(out["par"])
+                lane_h = np.asarray(out["lane"])
+                st_h = {k: np.asarray(v) for k, v in out["st"].items()}
+                inv_h = np.asarray(out["inv"])
+            for li in range(nlev):
+                n_lvl, n_viol, faults, n_expand, n_genl = (
+                    int(x) for x in stats[li, :5])
+                res.distinct_states += n_lvl
+                res.generated_states += n_genl
+                res.overflow_faults += faults
+                res.violations_global += n_viol
+                if self.store_states and n_lvl:
+                    # n_lvl == 0 appends nothing: the spill archive's
+                    # gid->row mapping is cumulative, not per-level
+                    # (flush_archives skips empty levels the same way)
+                    self._archive_level(
+                        par_h[li, :n_lvl].copy(),
+                        lane_h[li, :n_lvl].copy(),
+                        {k: np.moveaxis(v[..., li, :n_lvl],
+                                        -1, 0).copy()
+                         for k, v in st_h.items()})
+                if n_viol:
+                    rows = {k: np.moveaxis(v[..., li, :n_lvl], -1, 0)
+                            for k, v in st_h.items()}
+                    for j, nm in enumerate(self.inv_names):
+                        for s in np.nonzero(~inv_h[j, li, :n_lvl])[0]:
+                            vsv, vh = decode(
+                                lay, {kk: np.asarray(rows[kk][s])
+                                      for kk in rows})
+                            res.violations.append(Violation(
+                                nm, n_states + int(s), state=vsv,
+                                hist=vh))
+                if n_lvl or n_genl:
+                    depth += 1
+                    # counted inside the depth gate (engine/bfs does
+                    # the same) so levels_fused ≡ depth advanced in
+                    # every engine and (depth - levels_fused) is
+                    # exactly the per-level-driver level count
+                    res.levels_fused += 1
+                    res.level_sizes.append(n_expand)
+                n_states += n_lvl
+                n_vis += n_lvl
         if n_states >= 2 ** 31 - 1:
             raise RuntimeError(
                 "state-id space exhausted (2^31 ids): run exceeds "
@@ -763,11 +772,13 @@ class SpillEngine(Engine):
                         for k, v in frd.items()}
                 frontier_blocks = [
                     (fr_h, np.asarray(gdd)[keep].astype(np.int32))]
+        self._obs.dispatch(kind="burst", depth=depth, frontier=nf,
+                           metrics=res.metrics.as_dict())
         if verbose:
             print(f"burst: {nlev} levels to depth {depth} "
                   f"(total {res.distinct_states}), frontier "
                   f"{sum(int(g.shape[0]) for _r, g in frontier_blocks)}, "
-                  f"{time.time() - t1:.2f}s", flush=True)
+                  f"{time.perf_counter() - t1:.2f}s", flush=True)
         return (carry, frontier_blocks, depth, n_states, n_vis, True,
                 bailed)
 
@@ -779,8 +790,9 @@ class SpillEngine(Engine):
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
-              verbose: bool = False) -> CheckResult:
-        t0 = time.time()
+              verbose: bool = False, obs=None) -> CheckResult:
+        obs = self._obs = obs if obs is not None else NULL_OBS
+        t0 = time.perf_counter()
         lay = self.lay
         frontier_keys: List[np.ndarray] = []   # host-table mode only
 
@@ -896,18 +908,20 @@ class SpillEngine(Engine):
             parts = self._lvl_parts[-1]
             if not parts:
                 return
-            if self._arch is not None:
-                self._arch.append_level_parts(parts)
-            else:
-                self._parents.append(np.concatenate(
-                    [p["lpar"] for p in parts]))
-                self._lanes.append(np.concatenate(
-                    [p["llane"] for p in parts]))
-                keys = parts[0]["rows"].keys()
-                self._states.append(
-                    {k: np.moveaxis(np.concatenate(
-                        [p["rows"][k] for p in parts], axis=-1), -1, 0)
-                     for k in keys})
+            with obs.span("archive_io"):
+                if self._arch is not None:
+                    self._arch.append_level_parts(parts)
+                else:
+                    self._parents.append(np.concatenate(
+                        [p["lpar"] for p in parts]))
+                    self._lanes.append(np.concatenate(
+                        [p["llane"] for p in parts]))
+                    keys = parts[0]["rows"].keys()
+                    self._states.append(
+                        {k: np.moveaxis(np.concatenate(
+                            [p["rows"][k] for p in parts], axis=-1),
+                            -1, 0)
+                         for k in keys})
             # the archive holds its own copies/files now; dropping the
             # part refs keeps host RSS frontier-bounded
             self._lvl_parts[-1] = []
@@ -929,7 +943,7 @@ class SpillEngine(Engine):
                     frontier_keys.append(fk_r)
             res.generated_states = n_roots
         if stop_on_violation and res.violations:
-            res.seconds = time.time() - t0
+            res.seconds = time.perf_counter() - t0
             return res
 
         # ---- level loop ---------------------------------------------
@@ -975,7 +989,7 @@ class SpillEngine(Engine):
                 # growth machinery) runs it below
             burst_ok = True        # re-arm after a per-level level
             depth += 1
-            t1 = time.time()
+            t1 = time.perf_counter()
             self._lvl_parts.append([])
             level_new = 0
             level_gen = 0
@@ -1011,19 +1025,25 @@ class SpillEngine(Engine):
 
             def drain_blks():
                 nonlocal pending_blks
-                for blk in pending_blks:
-                    blk = self._materialize_blk(blk)
-                    if self.host_table:
-                        # harvest defers to the level-end sweep: the
-                        # host partitions judge the whole level's keys
-                        # at once, in enumeration order
-                        level_blks.append(blk)
-                        continue
-                    out = harvest_block(blk)
-                    if out is not None:
-                        next_blocks.append(out[:2])
-                pending_blks = []
+                if not pending_blks:
+                    return
+                with obs.span("harvest"):
+                    for blk in pending_blks:
+                        blk = self._materialize_blk(blk)
+                        if self.host_table:
+                            # harvest defers to the level-end sweep:
+                            # the host partitions judge the whole
+                            # level's keys at once, in enumeration
+                            # order
+                            level_blks.append(blk)
+                            continue
+                        out = harvest_block(blk)
+                        if out is not None:
+                            next_blocks.append(out[:2])
+                    pending_blks = []
 
+            _lvl_span = obs.span("level_dispatch")
+            _lvl_span.__enter__()
             seg_iter = self._resegment(frontier_blocks, self.SEGF)
             staged = next(seg_iter, None)
             staged_dev = (self._stage_segment(*staged)
@@ -1095,6 +1115,7 @@ class SpillEngine(Engine):
             carry, blk = self._spill_segment(carry, n_rem)
             settle_blk(blk)
             drain_gen()
+            _lvl_span.__exit__(None, None, None)
             drain_blks()
             if self.host_table and level_blks:
                 # the level's keys — unique (device cache is complete
@@ -1105,17 +1126,18 @@ class SpillEngine(Engine):
                     [np.ascontiguousarray(b["lfp"].T)
                      for b in level_blks])
                 lkeep = self._sweep_level_keys(lkeys)
-                off = 0
-                for b in level_blks:
-                    nb = b["n"]
-                    kb = lkeep[off:off + nb]
-                    off += nb
-                    level_new += int(kb.sum())
-                    out = harvest_block(b, kb)
-                    if out is not None:
-                        rows_b, gids_b, fk_b = out
-                        next_blocks.append((rows_b, gids_b))
-                        next_keys.append(fk_b)
+                with obs.span("harvest"):
+                    off = 0
+                    for b in level_blks:
+                        nb = b["n"]
+                        kb = lkeep[off:off + nb]
+                        off += nb
+                        level_new += int(kb.sum())
+                        out = harvest_block(b, kb)
+                        if out is not None:
+                            rows_b, gids_b, fk_b = out
+                            next_blocks.append((rows_b, gids_b))
+                            next_keys.append(fk_b)
             flush_archives()
             if level_new == 0 and level_gen == 0:
                 # pruned-only frontier cannot occur here (host drops
@@ -1140,15 +1162,20 @@ class SpillEngine(Engine):
                 self._save_spill_checkpoint(
                     checkpoint_path, carry, res, frontier_blocks,
                     frontier_keys, depth, n_states, n_vis)
+            obs.dispatch(
+                kind="level", depth=depth,
+                frontier=sum(int(g.shape[0])
+                             for _r, g in frontier_blocks),
+                metrics=res.metrics.as_dict())
             if stop_on_violation and res.violations:
                 break
             if verbose:
                 print(f"depth {depth}: +{level_new} states "
                       f"(total {res.distinct_states}), "
                       f"frontier {sum(int(g.shape[0]) for _r, g in frontier_blocks)}, "
-                      f"{time.time() - t1:.2f}s", flush=True)
+                      f"{time.perf_counter() - t1:.2f}s", flush=True)
         res.depth = depth
-        res.seconds = time.time() - t0
+        res.seconds = time.perf_counter() - t0
         return res
 
     # ------------------------------------------------------------------
@@ -1175,6 +1202,14 @@ class SpillEngine(Engine):
 
     def _save_spill_checkpoint(self, path, carry, res, frontier_blocks,
                                frontier_keys, depth, n_states, n_vis):
+        with self._obs.span("checkpoint"):
+            return self._save_spill_checkpoint_impl(
+                path, carry, res, frontier_blocks, frontier_keys,
+                depth, n_states, n_vis)
+
+    def _save_spill_checkpoint_impl(self, path, carry, res,
+                                    frontier_blocks, frontier_keys,
+                                    depth, n_states, n_vis):
         # the table serializes SPARSE (occupied slot indices + keys),
         # and the sparsification runs ON DEVICE: deep runs pre-allocate
         # VCAP for the final level (2^28 slots = 4 GB of streams at

@@ -1,0 +1,176 @@
+"""Unified observability layer for every engine driver.
+
+One ``Obs`` bundle rides through a run and fans out to four sinks,
+each optional and individually cheap enough to leave on:
+
+- **spans** (`obs/spans.py`) — nested phase timers on
+  ``time.perf_counter()``, emitted as Chrome-trace JSON
+  (``--trace-timeline``, loads in Perfetto);
+- **ledger** (`obs/ledger.py`) — one JSONL record per dispatch
+  (``--ledger``): depth, frontier, the full metrics-registry snapshot,
+  states/sec, dedup hit rate, RSS, device memory — flushed per record
+  so a killed run keeps its telemetry;
+- **heartbeat** (`obs/heartbeat.py`) — a small JSON atomically
+  rewritten every dispatch (``--heartbeat``) so a watchdog can tell a
+  slow level from a dead tunnel;
+- **profiler** — opt-in ``jax.profiler.trace`` capture
+  (``--profile-dir``) with ``TraceAnnotation`` names matching the span
+  names, so the XLA device trace lines up with the host timeline.
+
+Engines take ``obs=None`` in ``check()``/``run()`` and default to
+``NULL_OBS`` (every hook a no-op); the CLI builds a real bundle from
+the flags via ``from_flags`` and owns its lifecycle
+(``start``/``finish``).  The counters themselves live in
+``obs/metrics.py``'s registry — see that module for why.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+from .heartbeat import Heartbeat
+from .ledger import RunLedger, device_memory_stats, rss_bytes
+from .metrics import (BURST_COUNTER_KEYS, CHECK_COUNTER_KEYS,
+                      SIM_COUNTER_KEYS, SIM_DISPATCH_KEYS,
+                      MetricsRegistry, check_stats, sim_counters,
+                      sim_stats)
+from .spans import SpanRecorder
+
+__all__ = [
+    "Obs", "NULL_OBS", "from_flags", "SpanRecorder", "RunLedger",
+    "Heartbeat", "MetricsRegistry", "check_stats", "sim_stats",
+    "sim_counters", "rss_bytes", "device_memory_stats",
+    "CHECK_COUNTER_KEYS", "BURST_COUNTER_KEYS", "SIM_COUNTER_KEYS",
+    "SIM_DISPATCH_KEYS",
+]
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+class Obs:
+    """Per-run observability bundle (see module docstring).  With no
+    sinks configured every hook is a no-op — the engines call
+    ``span``/``dispatch`` unconditionally."""
+
+    def __init__(self, spans: Optional[SpanRecorder] = None,
+                 ledger: Optional[RunLedger] = None,
+                 heartbeat: Optional[Heartbeat] = None,
+                 profile_dir: Optional[str] = None):
+        self.spans = spans
+        self.ledger = ledger
+        self.heartbeat = heartbeat
+        self.profile_dir = profile_dir
+        self._profiling = False
+        self._t0 = time.perf_counter()
+        self._n_dispatch = 0
+        if profile_dir and spans is not None:
+            # device traces only line up with the host timeline if the
+            # TraceAnnotation names match the span names
+            spans.annotate = True
+
+    @property
+    def enabled(self) -> bool:
+        return (self.spans is not None or self.ledger is not None
+                or self.heartbeat is not None
+                or self.profile_dir is not None)
+
+    # -- hooks the engines call ---------------------------------------
+
+    def span(self, name: str):
+        if self.spans is None:
+            return _NULL_CTX
+        return self.spans.span(name)
+
+    def dispatch(self, *, kind: str, depth: int, frontier: int = 0,
+                 metrics: Optional[Dict] = None,
+                 states: Optional[int] = None):
+        """One record per dispatch (burst device call / per-level round
+        trip / sim dispatch): ledger line + heartbeat rewrite."""
+        self._n_dispatch += 1
+        metrics = metrics or {}
+        if states is None:
+            states = int(metrics.get("distinct_states",
+                                     metrics.get("walker_steps", 0)))
+        if self.ledger is not None:
+            secs = time.perf_counter() - self._t0
+            # counters first, header fields second: the registry's
+            # `depth` counter is only finalized at run end, so the
+            # dispatch-passed depth must win
+            rec = dict(metrics)
+            rec["kind"] = kind
+            rec["depth"] = int(depth)
+            rec["frontier"] = int(frontier)
+            rec["dispatch"] = self._n_dispatch
+            rec["seconds"] = round(secs, 3)
+            rec["states_per_sec"] = round(states / max(secs, 1e-9), 1)
+            gen = int(metrics.get("generated_states", 0) or 0)
+            if gen:
+                rec["dedup_hit_rate"] = round(
+                    1.0 - int(metrics["distinct_states"]) / gen, 4)
+            rec["rss_bytes"] = rss_bytes()
+            dev = device_memory_stats()
+            if dev:
+                rec["device_memory"] = dev
+            self.ledger.record(rec)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(depth=depth, states=states)
+
+    # -- lifecycle (the CLI owns it) ----------------------------------
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        if self.profile_dir:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        return self
+
+    def finish(self, depth: Optional[int] = None,
+               states: Optional[int] = None, status: str = "finished"):
+        if self._profiling:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._profiling = False
+        if self.heartbeat is not None:
+            # a terminal status without fresh numbers (the CLI's
+            # failure path passes depth=None) still stamps the file —
+            # a watchdog must see "failed", not an eternal "running"
+            self.heartbeat.beat(
+                depth=depth if depth is not None
+                else self.heartbeat.last_depth,
+                states=int(states if states is not None
+                           else self.heartbeat.last_states),
+                status=status)
+        if self.ledger is not None:
+            self.ledger.close()
+        if self.spans is not None:
+            self.spans.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.finish()
+
+
+NULL_OBS = Obs()
+
+
+def from_flags(ledger: Optional[str] = None,
+               heartbeat: Optional[str] = None,
+               timeline: Optional[str] = None,
+               profile_dir: Optional[str] = None) -> Obs:
+    """Build the bundle the CLI flags describe (NULL_OBS when none are
+    set, so callers can pass the result unconditionally)."""
+    if not (ledger or heartbeat or timeline or profile_dir):
+        return NULL_OBS
+    return Obs(
+        spans=SpanRecorder(timeline) if (timeline or profile_dir)
+        else None,
+        ledger=RunLedger(ledger) if ledger else None,
+        heartbeat=Heartbeat(heartbeat) if heartbeat else None,
+        profile_dir=profile_dir)

@@ -83,6 +83,7 @@ except ImportError:                     # pragma: no cover - older jax
                          out_specs=out_specs, check_rep=False)
 
 from ..config import ModelConfig
+from ..obs import NULL_OBS
 from ..engine.bfs import (CheckResult, Engine, U32MAX, Violation, _cat,
                           _take, ckpt_archives, ckpt_carry, ckpt_read,
                           ckpt_result, ckpt_write)
@@ -807,8 +808,9 @@ class ShardedEngine(Engine):
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
-              verbose: bool = False) -> CheckResult:
-        t0 = time.time()
+              verbose: bool = False, obs=None) -> CheckResult:
+        obs = self._obs = obs if obs is not None else NULL_OBS
+        t0 = time.perf_counter()
         lay = self.lay
         D, W = self.D, self.W
         if resume_from is not None:
@@ -975,7 +977,7 @@ class ShardedEngine(Engine):
         # same branch (a process-local decision would deadlock the
         # mesh collectives under multi-controller runs)
         if stop_on_violation and res.violations_global:
-            res.seconds = time.time() - t0
+            res.seconds = time.perf_counter() - t0
             return res
 
         # burst_ok: a burst that committed levels then bailed keeps the
@@ -991,16 +993,18 @@ class ShardedEngine(Engine):
                 # stats readback for up to burst_levels small levels
                 # (_shard_burst).  nlev == 0 means the first level
                 # bailed — fall through to the per-level path below.
-                t1 = time.time()
-                carry = grow_table_if_needed(
-                    carry, min_add=self.burst_levels * kbd)
-                lv_left = min(self.burst_levels, max_depth - depth)
-                st_cap = max(1, min(max_states - res.distinct_states,
-                                    2 ** 31 - 1))
-                carry, bout = self._burst_mesh_jit(
-                    carry, self.FAM_CAPS, jnp.int32(lv_left),
-                    jnp.int32(st_cap))
-                stats = np.asarray(bout["stats"])  # [D, L_MAX+1, NS]
+                t1 = time.perf_counter()
+                with obs.span("burst_dispatch"):
+                    carry = grow_table_if_needed(
+                        carry, min_add=self.burst_levels * kbd)
+                    lv_left = min(self.burst_levels, max_depth - depth)
+                    st_cap = max(1,
+                                 min(max_states - res.distinct_states,
+                                     2 ** 31 - 1))
+                    carry, bout = self._burst_mesh_jit(
+                        carry, self.FAM_CAPS, jnp.int32(lv_left),
+                        jnp.int32(st_cap))
+                    stats = np.asarray(bout["stats"])  # [D,L_MAX+1,NS]
                 nlev = int(stats[0, -1, 0])
                 bailed = bool(stats[0, -1, 1])
                 res.burst_dispatches += 1
@@ -1009,6 +1013,8 @@ class ShardedEngine(Engine):
                     burst_ok = not bailed
                     d0 = depth
                     viol_any = bool(stats[0, -1, 3])
+                    _hv_span = obs.span("harvest")
+                    _hv_span.__enter__()
                     par_rows = lane_rows = st_rows = inv_rows = None
                     if self.store_states or viol_any:
                         par_rows = dict(local_rows(bout["par"]))
@@ -1068,6 +1074,7 @@ class ShardedEngine(Engine):
                             res.levels_fused += 1
                             res.level_sizes.append(
                                 int(stats[:, li, 3].sum()))
+                    _hv_span.__exit__(None, None, None)
                     if n_states >= 2 ** 31 - 1:
                         raise RuntimeError(
                             "state-id space exhausted (2^31 ids): run "
@@ -1082,16 +1089,21 @@ class ShardedEngine(Engine):
                         self._save_checkpoint(checkpoint_path, carry,
                                               res, depth, n_states,
                                               n_vis, n_front)
+                    obs.dispatch(kind="burst", depth=depth,
+                                 frontier=n_front,
+                                 metrics=res.metrics.as_dict())
                     if stop_on_violation and res.violations_global:
                         break
                     if verbose:
                         print(f"burst: {nlev} levels to depth {depth} "
                               f"(total {res.distinct_states}), "
                               f"frontier(max/dev) {n_front}, "
-                              f"{time.time() - t1:.2f}s")
+                              f"{time.perf_counter() - t1:.2f}s")
                     continue
             burst_ok = True        # re-arm after a per-level level
             depth += 1
+            _lvl_span = obs.span("level_dispatch")
+            _lvl_span.__enter__()
             carry = grow_table_if_needed(carry)
             while True:
                 carry, out = self._level_jit(carry, self.FAM_CAPS)
@@ -1135,7 +1147,9 @@ class ShardedEngine(Engine):
                     # the replayed level can add up to the NEW LB keys
                     # per shard: re-check the table load bound
                     carry = grow_table_if_needed(carry)
-            n_front = harvest(carry, out, scal)
+            _lvl_span.__exit__(None, None, None)
+            with obs.span("harvest"):
+                n_front = harvest(carry, out, scal)
             if int(scal[:, 0].sum()) == 0 and int(scal[:, 6].sum()) == 0:
                 depth -= 1
             else:
@@ -1144,6 +1158,8 @@ class ShardedEngine(Engine):
                     depth % max(1, checkpoint_every) == 0:
                 self._save_checkpoint(checkpoint_path, carry, res,
                                       depth, n_states, n_vis, n_front)
+            obs.dispatch(kind="level", depth=depth, frontier=n_front,
+                         metrics=res.metrics.as_dict())
             if stop_on_violation and res.violations_global:
                 break
             if verbose:
@@ -1151,7 +1167,7 @@ class ShardedEngine(Engine):
                       f"(total {res.distinct_states}), "
                       f"frontier(max/dev) {n_front}")
         res.depth = depth
-        res.seconds = time.time() - t0
+        res.seconds = time.perf_counter() - t0
         return res
 
     def _to_device(self, carry_np):
@@ -1211,15 +1227,18 @@ class ShardedEngine(Engine):
                 "ShardedEngine checkpoints are single-controller; use "
                 "MultiHostEngine (per-controller shard files) for "
                 "multi-process runs")
-        ckpt_write(path, carry, self.store_states, self._parents,
-                   self._lanes, self._states, res, dict(
-                       sharded=True, ckpt_format=_SHARDED_CKPT_FORMAT, D=self.D,
-                       chunk=self.chunk,
-                       LB=self.LB, VB=self.VB, FC=self.FC, SC=self.SC,
-                       fam_caps=list(self.FAM_CAPS),
-                       depth=depth, n_states=n_states,
-                       n_vis=[int(x) for x in n_vis],
-                       n_front=int(n_front), cfg=repr(self.cfg)))
+        with self._obs.span("checkpoint"):
+            ckpt_write(path, carry, self.store_states, self._parents,
+                       self._lanes, self._states, res, dict(
+                           sharded=True,
+                           ckpt_format=_SHARDED_CKPT_FORMAT, D=self.D,
+                           chunk=self.chunk,
+                           LB=self.LB, VB=self.VB, FC=self.FC,
+                           SC=self.SC,
+                           fam_caps=list(self.FAM_CAPS),
+                           depth=depth, n_states=n_states,
+                           n_vis=[int(x) for x in n_vis],
+                           n_front=int(n_front), cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
         from ..engine.bfs import CheckpointError

@@ -128,7 +128,7 @@ class MultiHostEngine(ShardedEngine):
         if self._arch_merged:
             return
         n_proc = jax.process_count()
-        deadline = time.time() + timeout_s
+        deadline = time.perf_counter() + timeout_s
         # this controller's own file carries the current run's stamp;
         # other controllers' files must match it (a reused trace_dir
         # can hold a previous run's archives until every controller of
@@ -145,7 +145,7 @@ class MultiHostEngine(ShardedEngine):
                         files.append(f)
                         break
                     f.close()
-                if time.time() > deadline:
+                if time.perf_counter() > deadline:
                     raise FileNotFoundError(
                         f"{self._arch_path(k)}: no archive with this "
                         f"run's stamp within {timeout_s}s — did "

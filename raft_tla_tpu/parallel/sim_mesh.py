@@ -29,7 +29,7 @@ import numpy as np
 
 from ..config import ModelConfig
 from ..sim.walker import (ST_HIT, ST_ITERS, ST_STEPS, SimEngine,
-                          SimResult)
+                          SimResult, dispatch_counters)
 
 
 class ShardedSimEngine:
@@ -61,27 +61,37 @@ class ShardedSimEngine:
             self.devices)
 
     def run(self, steps: int, steps_per_dispatch: int = 256,
-            stop_on_hit: bool = True, verbose: bool = False) -> SimResult:
-        t0 = time.time()
+            stop_on_hit: bool = True, verbose: bool = False,
+            obs=None) -> SimResult:
+        from ..obs import NULL_OBS
+        obs = obs if obs is not None else NULL_OBS
+        t0 = time.perf_counter()
         root_hit = self.sim._check_root()
         if root_hit is not None and stop_on_hit:
-            res = self._harvest(self.fresh_carry(), time.time() - t0)
+            res = self._harvest(self.fresh_carry(),
+                                time.perf_counter() - t0)
             res.hits.insert(0, root_hit)
             return res
         st = self.fresh_carry()
         done = 0
         while done < steps:
             k = min(steps_per_dispatch, steps - done)
-            st = self._pdisp(st, int(k), bool(stop_on_hit))
-            stats = np.asarray(st["stats"])       # [D, ST_LEN]
+            with obs.span("sim_dispatch"):
+                st = self._pdisp(st, int(k), bool(stop_on_hit))
+                stats = np.asarray(st["stats"])       # [D, ST_LEN]
             done = int(stats[:, ST_ITERS].max())
+            if obs.enabled:
+                obs.dispatch(
+                    kind="sim", depth=done, frontier=self.W,
+                    states=int(stats[:, ST_STEPS].sum()),
+                    metrics=dispatch_counters(stats, self.W))
             if verbose:
                 print(f"fleet: {done} iters, "
                       f"{int(stats[:, ST_STEPS].sum())} walker-steps "
                       f"across {self.D} devices", flush=True)
             if stop_on_hit and stats[:, ST_HIT].any():
                 break
-        res = self._harvest(st, time.time() - t0)
+        res = self._harvest(st, time.perf_counter() - t0)
         if root_hit is not None:
             res.hits.insert(0, root_hit)
         return res

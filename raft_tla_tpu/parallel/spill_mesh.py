@@ -50,6 +50,7 @@ from jax import lax
 
 from ..config import ModelConfig
 from ..engine.bfs import CheckResult, U32MAX, Violation
+from ..obs import NULL_OBS
 from ..engine.host_table import HostPartitionedTable, insert_np
 from ..engine.spill import SpillEngine
 from ..models.raft import init_state
@@ -290,7 +291,7 @@ class SpilledShardedEngine(ShardedEngine):
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
-              verbose: bool = False) -> CheckResult:
+              verbose: bool = False, obs=None) -> CheckResult:
         if checkpoint_path is not None or resume_from is not None:
             raise NotImplementedError(
                 "SpilledShardedEngine does not checkpoint yet — use "
@@ -299,7 +300,8 @@ class SpilledShardedEngine(ShardedEngine):
         assert jax.process_count() == 1, \
             "single-controller engine (MultiHostEngine composition " \
             "is future work)"
-        t0 = time.time()
+        obs = self._obs = obs if obs is not None else NULL_OBS
+        t0 = time.perf_counter()
         lay = self.lay
         D, W = self.D, self.W
         self._init_store()
@@ -356,6 +358,8 @@ class SpilledShardedEngine(ShardedEngine):
             dropped, prune-not-expand).  Returns per-device
             (rows, gids) or None."""
             nonlocal n_states
+            _hv = obs.span("harvest")
+            _hv.__enter__()
             out = [None] * D
             for d in range(D):
                 blk = blks[d]
@@ -399,6 +403,7 @@ class SpilledShardedEngine(ShardedEngine):
                               gids[keep],
                               blk["lkey"][keep]
                               if "lkey" in blk else None)
+            _hv.__exit__(None, None, None)
             return out
 
         frontier: List[List] = [[] for _ in range(D)]
@@ -413,7 +418,7 @@ class SpilledShardedEngine(ShardedEngine):
                     frontier_keys[d].append(fk_r)
         res.generated_states = len(rk)
         if stop_on_violation and res.violations:
-            res.seconds = time.time() - t0
+            res.seconds = time.perf_counter() - t0
             return res
 
         # burst_ok: a burst that committed levels then bailed keeps the
@@ -440,7 +445,7 @@ class SpilledShardedEngine(ShardedEngine):
             burst_ok = True        # re-arm after a per-level level
             depth += 1
             SEGB = self.LB             # per-device segment rows
-            t1 = time.time()
+            t1 = time.perf_counter()
             level_new = 0
             level_gen = 0
             next_frontier: List[List] = [[] for _ in range(D)]
@@ -465,6 +470,8 @@ class SpilledShardedEngine(ShardedEngine):
                     if outs[d] is not None:
                         next_frontier[d].append(outs[d][:2])
 
+            _lvl_span = obs.span("level_dispatch")
+            _lvl_span.__enter__()
             for seg in self._resegment_dev(frontier, SEGB):
                 carry = self._sgrow_table_if_needed(carry, n_vis)
                 carry = self._upload_seg(carry, seg)
@@ -482,6 +489,7 @@ class SpilledShardedEngine(ShardedEngine):
             # level end: spill the remainder everywhere
             nl = np.asarray(carry["n_lvl"])
             carry, blks = self._fetch_shards(carry, nl)
+            _lvl_span.__exit__(None, None, None)
             settle(blks)
             if self.host_table and level_events:
                 # per-device key streams in (spill-event) order: each
@@ -491,19 +499,21 @@ class SpilledShardedEngine(ShardedEngine):
                 # independent; the keep verdicts then filter the
                 # event-ordered blocks so gid assignment keeps the
                 # engine's deterministic (event, device) order
-                for d in range(D):
-                    dev_blks = [ev[d] for ev in level_events
-                                if ev[d] is not None]
-                    if not dev_blks:
-                        continue
-                    keys = np.concatenate(
-                        [b["lkey"][:b["n"]] for b in dev_blks])
-                    keep = self.hpts[d].sweep(keys.astype(np.uint32))
-                    off = 0
-                    for b in dev_blks:
-                        nb = b["n"]
-                        b["_keep"] = keep[off:off + nb]
-                        off += nb
+                with obs.span("host_sweep"):
+                    for d in range(D):
+                        dev_blks = [ev[d] for ev in level_events
+                                    if ev[d] is not None]
+                        if not dev_blks:
+                            continue
+                        keys = np.concatenate(
+                            [b["lkey"][:b["n"]] for b in dev_blks])
+                        keep = self.hpts[d].sweep(
+                            keys.astype(np.uint32))
+                        off = 0
+                        for b in dev_blks:
+                            nb = b["n"]
+                            b["_keep"] = keep[off:off + nb]
+                            off += nb
                 for ev in level_events:
                     fblks = [self._filter_blk(ev[d]) for d in range(D)]
                     for d in range(D):
@@ -530,15 +540,20 @@ class SpilledShardedEngine(ShardedEngine):
                 # its frontier's keys (the host partitions answer for
                 # everything archived)
                 carry, n_vis = self._reseed_shards(carry, frontier_keys)
+            obs.dispatch(
+                kind="level", depth=depth,
+                frontier=sum(int(g.shape[0])
+                             for q in frontier for _r, g in q),
+                metrics=res.metrics.as_dict())
             if stop_on_violation and res.violations:
                 break
             if verbose:
                 print(f"depth {depth}: +{level_new} states "
                       f"(total {res.distinct_states}), frontier "
                       f"{sum(int(g.shape[0]) for q in frontier for _r, g in q)}, "
-                      f"{time.time() - t1:.2f}s", flush=True)
+                      f"{time.perf_counter() - t1:.2f}s", flush=True)
         res.depth = depth
-        res.seconds = time.time() - t0
+        res.seconds = time.perf_counter() - t0
         return res
 
     # -- trace-archive composition ------------------------------------
@@ -555,17 +570,18 @@ class SpilledShardedEngine(ShardedEngine):
         parts, self._cur_parts = self._cur_parts, []
         if not parts:
             return
-        if self._arch is not None:
-            self._arch.append_level_parts(parts)
-            return
-        self._parents.append(np.concatenate(
-            [p["lpar"][:p["n"]] for p in parts]))
-        self._lanes.append(np.concatenate(
-            [p["llane"][:p["n"]] for p in parts]))
-        keys = parts[0]["rows_major"].keys()
-        self._states.append(
-            {k: np.concatenate([p["rows_major"][k][:p["n"]]
-                                for p in parts]) for k in keys})
+        with self._obs.span("archive_io"):
+            if self._arch is not None:
+                self._arch.append_level_parts(parts)
+                return
+            self._parents.append(np.concatenate(
+                [p["lpar"][:p["n"]] for p in parts]))
+            self._lanes.append(np.concatenate(
+                [p["llane"][:p["n"]] for p in parts]))
+            keys = parts[0]["rows_major"].keys()
+            self._states.append(
+                {k: np.concatenate([p["rows_major"][k][:p["n"]]
+                                    for p in parts]) for k in keys})
 
     # -- host-partitioned table composition ---------------------------
 
@@ -640,33 +656,36 @@ class SpilledShardedEngine(ShardedEngine):
         untouched); bailed=True means the call ended in a bail (even
         after committing levels), so re-entering the burst on the
         unchanged frontier would deterministically bail again."""
-        t1 = time.time()
+        t1 = time.perf_counter()
         lay = self.lay
         D = self.D
-        kbd = self._mesh_burst_width()
-        seg = []
-        for q in frontier:
-            if q:
-                keys = q[0][0].keys()
-                seg.append((
-                    {k: np.concatenate([r[k] for r, _g in q])
-                     for k in keys},
-                    np.concatenate([g for _r, g in q])))
-            else:
-                seg.append(None)
-        carry = self._sgrow_table_if_needed(
-            carry, n_vis, min_add=self.burst_levels * kbd)
-        carry = self._upload_seg(carry, seg)
-        # the burst's in-loop gid refresh is device-major arithmetic
-        # from g_off; seed it at the next id this engine would assign
-        carry["g_off"] = jnp.full((D,), n_states, jnp.int32)
-        lv_left = min(self.burst_levels, max_depth - depth)
-        st_cap = max(1, min(max_states - res.distinct_states,
-                            2 ** 31 - 1))
-        carry, bout = self._burst_mesh_jit(
-            carry, self.FAM_CAPS, jnp.int32(lv_left),
-            jnp.int32(st_cap))
-        stats = np.asarray(bout["stats"])       # [D, L_MAX+1, NS]
+        obs = self._obs
+        with obs.span("burst_dispatch"):
+            kbd = self._mesh_burst_width()
+            seg = []
+            for q in frontier:
+                if q:
+                    keys = q[0][0].keys()
+                    seg.append((
+                        {k: np.concatenate([r[k] for r, _g in q])
+                         for k in keys},
+                        np.concatenate([g for _r, g in q])))
+                else:
+                    seg.append(None)
+            carry = self._sgrow_table_if_needed(
+                carry, n_vis, min_add=self.burst_levels * kbd)
+            carry = self._upload_seg(carry, seg)
+            # the burst's in-loop gid refresh is device-major
+            # arithmetic from g_off; seed it at the next id this
+            # engine would assign
+            carry["g_off"] = jnp.full((D,), n_states, jnp.int32)
+            lv_left = min(self.burst_levels, max_depth - depth)
+            st_cap = max(1, min(max_states - res.distinct_states,
+                                2 ** 31 - 1))
+            carry, bout = self._burst_mesh_jit(
+                carry, self.FAM_CAPS, jnp.int32(lv_left),
+                jnp.int32(st_cap))
+            stats = np.asarray(bout["stats"])       # [D, L_MAX+1, NS]
         nlev = int(stats[0, -1, 0])
         bailed = bool(stats[0, -1, 1])
         res.burst_dispatches += 1
@@ -675,6 +694,8 @@ class SpilledShardedEngine(ShardedEngine):
             return (carry, frontier, depth, n_states, n_vis, False,
                     bailed)
         viol_any = bool(stats[0, -1, 3])
+        _hv_span = obs.span("harvest")
+        _hv_span.__enter__()
         par_h = lane_h = st_h = inv_h = None
         if self.store_states or viol_any:
             par_h = np.asarray(bout["par"])     # [D, L_MAX, kbd]
@@ -722,6 +743,7 @@ class SpilledShardedEngine(ShardedEngine):
             n_states += n_lvl
             for d in range(D):
                 n_vis[d] += nl[d]
+        _hv_span.__exit__(None, None, None)
         if n_states >= 2 ** 31 - 1:
             raise RuntimeError("state-id space exhausted (2^31 ids)")
         # rebuild the per-device host frontier from the device shards
@@ -753,11 +775,16 @@ class SpilledShardedEngine(ShardedEngine):
                         {k: np.ascontiguousarray(v[d][keep])
                          for k, v in rows.items()},
                         gids[d][keep].astype(np.int32)))
+        obs.dispatch(
+            kind="burst", depth=depth,
+            frontier=sum(int(g.shape[0])
+                         for q in frontier for _r, g in q),
+            metrics=res.metrics.as_dict())
         if verbose:
             print(f"burst: {nlev} levels to depth {depth} "
                   f"(total {res.distinct_states}), frontier "
                   f"{sum(int(g.shape[0]) for q in frontier for _r, g in q)}, "
-                  f"{time.time() - t1:.2f}s", flush=True)
+                  f"{time.perf_counter() - t1:.2f}s", flush=True)
         return carry, frontier, depth, n_states, n_vis, True, bailed
 
     # -- trip handling ------------------------------------------------

@@ -135,6 +135,25 @@ class SimResult:
 (ST_STEPS, ST_RESTARTS, ST_DEADLOCKS, ST_ITERS, ST_HIT, ST_SAMPLED,
  ST_PROMOS, ST_LEN) = range(8)
 
+
+def dispatch_counters(stats2d: np.ndarray, walkers: int):
+    """Per-dispatch ledger counters off the raw [n_shards, ST_LEN]
+    stats matrix — the single stats→names mapping the sim ledger
+    records use (key set pinned as obs.metrics.SIM_DISPATCH_KEYS, the
+    subset of the SimResult counters knowable without a bloom fetch).
+    Both sim engines (single-device and the pmapped fleet) call it, so
+    their ledger schemas cannot drift."""
+    return {
+        "walkers": int(walkers),
+        "steps_dispatched": int(stats2d[:, ST_ITERS].max()),
+        "walker_steps": int(stats2d[:, ST_STEPS].sum()),
+        "sampled_steps": int(stats2d[:, ST_SAMPLED].sum()),
+        "restarts": int(stats2d[:, ST_RESTARTS].sum()),
+        "deadlocks": int(stats2d[:, ST_DEADLOCKS].sum()),
+        "promotions": int(stats2d[:, ST_PROMOS].sum()),
+        "hits": int(stats2d[:, ST_HIT].sum()),
+    }
+
 _SCORE_LEADER = 1 << 20
 _SCORE_NMC = 1 << 10
 
@@ -430,25 +449,44 @@ class SimEngine:
     # ------------------------------------------------------------------
 
     def run(self, steps: int, steps_per_dispatch: int = 256,
-            stop_on_hit: bool = True, verbose: bool = False) -> SimResult:
+            stop_on_hit: bool = True, verbose: bool = False,
+            obs=None) -> SimResult:
         """Walk for up to ``steps`` synchronous fleet steps (early exit
-        on the first scenario/invariant hit when stop_on_hit)."""
-        t0 = time.time()
+        on the first scenario/invariant hit when stop_on_hit).
+
+        obs — an ``obs.Obs`` bundle: one ledger record + heartbeat
+        rewrite per device dispatch (the heartbeat's ``depth`` is the
+        fleet-synchronous iteration count — a random walk has no BFS
+        depth)."""
+        from ..obs import NULL_OBS
+        obs = obs if obs is not None else NULL_OBS
+        t0 = time.perf_counter()
         # the step loop checks sampled SUCCESSORS; the root itself must
         # be checked once up front (a safety-invariant target can be
         # violated at depth 0 — check/trace report it there too)
         root_hit = self._check_root()
         if root_hit is not None and stop_on_hit:
-            res = self._harvest(self.fresh_carry(), time.time() - t0)
+            res = self._harvest(self.fresh_carry(),
+                                time.perf_counter() - t0)
             res.hits.insert(0, root_hit)
             return res
         st = self.fresh_carry()
         done = 0
         while done < steps:
             k = min(steps_per_dispatch, steps - done)
-            st = self._dispatch(st, int(k), bool(stop_on_hit))
-            stats = np.asarray(st["stats"])     # the ONE per-dispatch sync
+            with obs.span("sim_dispatch"):
+                st = self._dispatch(st, int(k), bool(stop_on_hit))
+                stats = np.asarray(st["stats"])   # the ONE per-dispatch
+                # sync
             done = int(stats[ST_ITERS])
+            if obs.enabled:
+                # light per-dispatch counters straight off the stats
+                # vector (no bloom fetch mid-run); key set pinned by
+                # obs.metrics.SIM_DISPATCH_KEYS
+                obs.dispatch(
+                    kind="sim", depth=done, frontier=self.W,
+                    states=int(stats[ST_STEPS]),
+                    metrics=dispatch_counters(stats[None], self.W))
             if verbose:
                 print(f"sim: {done} iters, {int(stats[ST_STEPS])} "
                       f"walker-steps, {int(stats[ST_RESTARTS])} "
@@ -456,7 +494,7 @@ class SimEngine:
                       flush=True)
             if stop_on_hit and stats[ST_HIT]:
                 break
-        res = self._harvest(st, time.time() - t0)
+        res = self._harvest(st, time.perf_counter() - t0)
         if root_hit is not None:
             res.hits.insert(0, root_hit)
         return res

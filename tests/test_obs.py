@@ -1,0 +1,303 @@
+"""Unified observability layer (raft_tla_tpu/obs): span recorder,
+metrics registry, ledger, heartbeat — and the cross-engine telemetry
+parity the registry exists to guarantee.
+
+The parity test is the structural guard against the PR-5 drift class
+(`levels_fused` counted differently per harvest loop): all five
+engines run the same tiny config and must emit the identical registry
+key set, with the burst counters byte-equal between the ledger's final
+record, the --stats-json payload and the checkpoint meta.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+from raft_tla_tpu.obs import (CHECK_COUNTER_KEYS, BURST_COUNTER_KEYS,
+                              SIM_DISPATCH_KEYS, Heartbeat,
+                              MetricsRegistry, Obs, RunLedger,
+                              SpanRecorder, check_stats)
+from raft_tla_tpu.obs.heartbeat import read_heartbeat
+
+# the same tiny config for every engine (test_sharded's micro: VIEW-
+# only constraints so count parity is representative-insensitive)
+TINY = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    max_inflight_override=2, next_family=NEXT_ASYNC, symmetry=False,
+    constraints=("BoundedInFlightMessages", "BoundedRequestVote",
+                 "BoundedLogSize", "BoundedTerms"),
+    invariants=("ElectionSafety", "LogMatching"),
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+
+
+# ---------------------------------------------------------------------
+# unit tests (smoke tier: no device programs beyond import)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_metrics_registry_is_strict():
+    m = MetricsRegistry()
+    m.register("a", 1)
+    m.inc("a", 2)
+    assert m.get("a") == 3
+    with pytest.raises(ValueError):
+        m.register("a")            # double registration
+    with pytest.raises(KeyError):
+        m.set("typo", 1)           # undeclared counter fails loudly
+    assert m.as_dict() == {"a": 3}
+
+
+@pytest.mark.smoke
+def test_check_result_counters_are_registry_views():
+    from raft_tla_tpu.engine.bfs import CheckResult
+    r = CheckResult(distinct_states=7, generated_states=9)
+    r.levels_fused += 2
+    r.depth = 5
+    # the attribute IS the registry entry — one store, no copies
+    assert r.metrics.get("levels_fused") == 2
+    assert r.metrics.get("depth") == 5
+    assert tuple(r.metrics.keys()) == CHECK_COUNTER_KEYS
+
+
+@pytest.mark.smoke
+def test_check_stats_keys_byte_compatible():
+    """--stats-json keys must match the pre-registry CLI output
+    exactly (acceptance: byte-compatible in keys)."""
+    from raft_tla_tpu.engine.bfs import CheckResult
+    r = CheckResult(distinct_states=10, generated_states=20, depth=3)
+    # engine payload (fp_bits given)
+    out = check_stats(r.metrics.as_dict(), 1.5, 0, fp_bits=64)
+    assert tuple(out.keys()) == (
+        "distinct_states", "generated_states", "depth", "seconds",
+        "states_per_sec", "dedup_hit_rate", "violations", "fp_bits",
+        "expected_fp_collisions", "levels_fused", "burst_dispatches",
+        "burst_bailouts")
+    # oracle payload (no engine telemetry)
+    out = check_stats(r.metrics.as_dict(), 1.5, 2)
+    assert tuple(out.keys()) == (
+        "distinct_states", "generated_states", "depth", "seconds",
+        "states_per_sec", "dedup_hit_rate", "violations")
+    # pin_interior_states appears only when nonzero, after violations
+    r.pin_interior_states = 4
+    out = check_stats(r.metrics.as_dict(), 1.5, 0, fp_bits=64)
+    keys = list(out.keys())
+    assert keys.index("pin_interior_states") == \
+        keys.index("violations") + 1
+
+
+@pytest.mark.smoke
+def test_span_recorder_nesting_and_file(tmp_path):
+    path = str(tmp_path / "tl.json")
+    rec = SpanRecorder(path)
+    with rec.span("outer"):
+        with rec.span("inner"):
+            pass
+        with rec.span("inner"):
+            pass
+    rec.close()
+    events = json.load(open(path))
+    assert [e["name"] for e in events] == ["inner", "inner", "outer"]
+    for e in events:
+        assert e["ph"] == "X" and e["ts"] >= 0 and e["dur"] >= 0
+    outer = events[-1]
+    for inner in events[:2]:
+        # proper nesting: inner spans inside the outer interval
+        assert inner["ts"] >= outer["ts"]
+        assert inner["ts"] + inner["dur"] <= \
+            outer["ts"] + outer["dur"] + 1.0
+    tot = rec.totals()
+    assert tot["inner"]["count"] == 2 and tot["outer"]["count"] == 1
+
+
+@pytest.mark.smoke
+def test_span_recorder_killed_run_file_parses(tmp_path):
+    """A run killed mid-span-stream must leave a loadable timeline
+    (missing ] only — the trace-event spec makes it optional)."""
+    path = str(tmp_path / "tl.json")
+    rec = SpanRecorder(path)
+    with rec.span("a"):
+        pass
+    with rec.span("b"):
+        pass
+    # no close(): simulate the kill; repair exactly as Perfetto does
+    text = open(path).read()
+    assert not text.rstrip().endswith("]")
+    events = json.loads(text.rstrip().rstrip(",") + "]")
+    assert [e["name"] for e in events] == ["a", "b"]
+
+
+@pytest.mark.smoke
+def test_heartbeat_and_ledger(tmp_path):
+    hb_path = str(tmp_path / "hb.json")
+    hb = Heartbeat(hb_path)
+    hb.beat(depth=3, states=42)
+    obj = read_heartbeat(hb_path)
+    assert obj["depth"] == 3 and obj["states_enqueued"] == 42
+    assert obj["pid"] == os.getpid() and obj["status"] == "running"
+    hb.beat(depth=4, states=50, status="finished")
+    assert read_heartbeat(hb_path)["status"] == "finished"
+    # no .tmp leftover (write-then-rename)
+    assert not os.path.exists(hb_path + ".tmp")
+
+    led_path = str(tmp_path / "run.jsonl")
+    led = RunLedger(led_path)
+    led.record({"kind": "level", "depth": 1})
+    led.record({"kind": "burst", "depth": 4})
+    # readable BEFORE close: the killed-run contract
+    lines = [json.loads(x) for x in open(led_path)]
+    assert [x["kind"] for x in lines] == ["level", "burst"]
+    assert all("ts" in x and "t_mono" in x for x in lines)
+    led.close()
+
+
+@pytest.mark.smoke
+def test_obs_dispatch_record_shape(tmp_path):
+    led_path = str(tmp_path / "run.jsonl")
+    obs = Obs(ledger=RunLedger(led_path),
+              heartbeat=Heartbeat(str(tmp_path / "hb.json")))
+    obs.start()
+    # the dispatch-passed depth must win over the registry's stale
+    # `depth` counter (finalized only at run end)
+    obs.dispatch(kind="level", depth=9, frontier=5,
+                 metrics={"distinct_states": 100,
+                          "generated_states": 200, "depth": 0})
+    obs.finish(depth=9, states=100)
+    rec = json.loads(open(led_path).readline())
+    assert rec["depth"] == 9 and rec["kind"] == "level"
+    assert rec["frontier"] == 5 and rec["rss_bytes"] > 0
+    assert rec["dedup_hit_rate"] == 0.5
+    hb = read_heartbeat(str(tmp_path / "hb.json"))
+    assert hb["depth"] == 9 and hb["status"] == "finished"
+
+
+# ---------------------------------------------------------------------
+# cross-engine telemetry parity (the acceptance test): all five
+# engines, same tiny config, identical registry key sets; burst
+# counters consistent between ledger, --stats-json payload and
+# checkpoint meta
+# ---------------------------------------------------------------------
+
+
+def _run_with_obs(name, make_engine, tmp_path, checkpoint=True):
+    led_path = str(tmp_path / f"{name}.jsonl")
+    hb_path = str(tmp_path / f"{name}.hb.json")
+    ckpt_path = str(tmp_path / f"{name}.ckpt")
+    obs = Obs(ledger=RunLedger(led_path), heartbeat=Heartbeat(hb_path))
+    obs.start()
+    eng = make_engine()
+    kw = dict(checkpoint_path=ckpt_path, checkpoint_every=1) \
+        if checkpoint else {}
+    r = eng.check(obs=obs, **kw)
+    obs.finish(depth=int(r.depth), states=int(r.distinct_states))
+    recs = [json.loads(x) for x in open(led_path)]
+    assert recs, f"{name}: no ledger records"
+    stats = check_stats(r.metrics.as_dict(), r.seconds,
+                        len(r.violations), fp_bits=64)
+    meta = None
+    if checkpoint:
+        z = np.load(ckpt_path, allow_pickle=False)
+        meta = json.loads(str(z["meta"]))
+        z.close()
+    return r, recs, stats, meta, read_heartbeat(hb_path)
+
+
+def test_telemetry_parity_all_engines(tmp_path):
+    """bfs / spill / mesh / spill_mesh on the same tiny config: the
+    registry key set is identical everywhere, and the burst counter
+    triple agrees between the ledger's final record, the stats payload
+    and the checkpoint meta (where the engine checkpoints)."""
+    from raft_tla_tpu.engine.bfs import Engine
+    from raft_tla_tpu.engine.spill import SpillEngine
+    from raft_tla_tpu.parallel.mesh import ShardedEngine
+    from raft_tla_tpu.parallel.spill_mesh import SpilledShardedEngine
+
+    engines = {
+        "bfs": (lambda: Engine(TINY, chunk=64, store_states=False),
+                True),
+        "spill": (lambda: SpillEngine(
+            TINY, chunk=64, store_states=False, seg=1 << 10,
+            vcap=1 << 12, sync_every=2), True),
+        "mesh": (lambda: ShardedEngine(TINY, chunk=64,
+                                       store_states=False), True),
+        # SpilledShardedEngine does not checkpoint yet (its check
+        # raises) — ledger/stats parity only
+        "spill_mesh": (lambda: SpilledShardedEngine(
+            TINY, chunk=64, store_states=False, lcap=1 << 11), False),
+    }
+    key_sets, counts = {}, {}
+    for name, (make, ckpt) in engines.items():
+        r, recs, stats, meta, hb = _run_with_obs(
+            name, make, tmp_path, checkpoint=ckpt)
+        # 1. the registry key set — structural identity across engines
+        key_sets[name] = tuple(r.metrics.keys())
+        assert key_sets[name] == CHECK_COUNTER_KEYS, name
+        # 2. every ledger record carries every registry key
+        for rec in recs:
+            missing = set(CHECK_COUNTER_KEYS) - set(rec)
+            assert not missing, f"{name}: ledger record lacks {missing}"
+        # 3. burst counters: ledger final record == stats payload
+        last = recs[-1]
+        for k in BURST_COUNTER_KEYS:
+            assert last[k] == stats[k], (name, k)
+        # ... == checkpoint meta (the third historical copy)
+        if meta is not None:
+            for k in BURST_COUNTER_KEYS:
+                assert meta[k] == stats[k], (name, k)
+            assert meta["distinct"] == stats["distinct_states"], name
+        # 4. heartbeat final depth == the run's reported depth
+        assert hb["depth"] == r.depth == stats["depth"], name
+        assert hb["states_enqueued"] == r.distinct_states, name
+        assert hb["status"] == "finished", name
+        # the fused path engaged (so the burst counters are live, not
+        # trivially zero) — every engine's default burst must fire on
+        # this tiny space
+        assert r.levels_fused > 0, name
+        counts[name] = (r.distinct_states, r.depth,
+                        tuple(r.level_sizes))
+    # identical key set across all four engines
+    assert len(set(key_sets.values())) == 1, key_sets
+    # and (belt + suspenders) identical counts — same config, same
+    # space, four engines
+    assert len(set(counts.values())) == 1, counts
+
+
+def test_telemetry_parity_sim_engine(tmp_path):
+    """The fifth engine family: the sim ledger's per-dispatch records
+    carry exactly the canonical SIM_DISPATCH_KEYS, consistent with the
+    SimResult the run returns."""
+    from raft_tla_tpu.sim.walker import SimEngine
+
+    cfg = TINY.with_(invariants=("ElectionSafety",))
+    led_path = str(tmp_path / "sim.jsonl")
+    hb_path = str(tmp_path / "sim.hb.json")
+    obs = Obs(ledger=RunLedger(led_path), heartbeat=Heartbeat(hb_path))
+    obs.start()
+    eng = SimEngine(cfg, walkers=8, max_depth=8, seed=0,
+                    bloom_bits=12)
+    r = eng.run(steps=24, steps_per_dispatch=8, stop_on_hit=False)
+    # rerun through run(obs=...) — separate engine so the jit caches
+    # stay warm from the first run
+    r = SimEngine(cfg, walkers=8, max_depth=8, seed=0,
+                  bloom_bits=12).run(steps=24, steps_per_dispatch=8,
+                                     stop_on_hit=False, obs=obs)
+    obs.finish(depth=int(r.steps_dispatched),
+               states=int(r.walker_steps))
+    recs = [json.loads(x) for x in open(led_path)]
+    assert recs, "sim wrote no ledger records"
+    for rec in recs:
+        missing = set(SIM_DISPATCH_KEYS) - set(rec)
+        assert not missing, f"sim ledger record lacks {missing}"
+        assert rec["kind"] == "sim"
+    last = recs[-1]
+    # final record consistent with the returned SimResult
+    assert last["steps_dispatched"] == r.steps_dispatched
+    assert last["walker_steps"] == r.walker_steps
+    assert last["restarts"] == r.restarts
+    hb = read_heartbeat(hb_path)
+    assert hb["depth"] == r.steps_dispatched
+    assert hb["states_enqueued"] == r.walker_steps

@@ -1,11 +1,16 @@
 #!/usr/bin/env bash
 # Sub-minute CPU-only CI gate: runs exactly the `smoke` pytest marker
-# set (pyproject.toml) with the TPU plugin forced off.  Independent of
+# set (pyproject.toml) with the TPU plugin forced off, then the
+# observability smoke step (tools/obs_smoke.py): one tiny check with
+# --ledger --heartbeat --trace-timeline, validating that the JSONL
+# parses, spans nest (every end has a start, no negative durations)
+# and the heartbeat depth matches the final stats.  Independent of
 # the tier-1 budget — future PRs get a fast red/green signal even when
 # the full differential suite would blow the harness timeout.
 #
 # Usage: tools/ci_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m smoke \
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m smoke \
     -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python tools/obs_smoke.py

@@ -1,0 +1,133 @@
+"""Tail a run's heartbeat (and optionally its ledger) and render live
+progress — the watchdog half of the obs layer.
+
+A long tunneled-TPU run used to be a black box: rounds 4-5 lost
+multi-hour runs to dropped tunnels that looked exactly like big
+levels.  The engines now rewrite ``--heartbeat FILE`` atomically every
+dispatch; this tool reads it (plus the last ``--ledger`` records for
+throughput) and prints one status line per interval:
+
+  depth 17  1,642,844 states  5,120/s  last dispatch 4s ago  pid 3406 alive
+
+A heartbeat older than ``--stale`` seconds (default 300 — a slow level
+on the tunneled runtime can legitimately take minutes) or a dead pid
+flags the run STALLED/DEAD.
+
+Usage:
+  python tools/watch.py HEARTBEAT [--ledger FILE] [--interval SEC]
+                        [--stale SEC] [--once]
+
+``--once`` prints a single line and exits 0 (healthy), 1 (stalled or
+dead), 2 (no heartbeat yet) — the shape a cron watchdog wants.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from raft_tla_tpu.obs.heartbeat import read_heartbeat  # noqa: E402
+
+
+def pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def last_ledger_records(path, n=2):
+    """The last n parseable records of a JSONL ledger (the final line
+    can be mid-write — skip anything that does not parse)."""
+    recs = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        return []
+    return recs[-n:]
+
+
+def status_line(hb_path, ledger_path, stale_s):
+    """(line, exit_code): 0 healthy, 1 stalled/dead, 2 unreadable."""
+    try:
+        hb = read_heartbeat(hb_path)
+    except (OSError, ValueError) as e:
+        return f"no heartbeat yet ({e})", 2
+    age = time.time() - hb["last_dispatch_ts"]
+    alive = pid_alive(int(hb["pid"]))
+    finished = hb.get("status") == "finished"
+    parts = [f"depth {hb['depth']}",
+             f"{hb['states_enqueued']:,} states"]
+    rate = None
+    if ledger_path:
+        recs = last_ledger_records(ledger_path)
+        if len(recs) == 2:
+            ds = (recs[1].get("distinct_states",
+                              recs[1].get("walker_steps", 0)) -
+                  recs[0].get("distinct_states",
+                              recs[0].get("walker_steps", 0)))
+            dt = recs[1].get("seconds", 0) - recs[0].get("seconds", 0)
+            if dt > 0:
+                rate = ds / dt
+        elif len(recs) == 1:
+            rate = recs[0].get("states_per_sec")
+    if rate is not None:
+        parts.append(f"{rate:,.0f}/s")
+    parts.append(f"last dispatch {age:.0f}s ago")
+    code = 0
+    if finished:
+        parts.append("FINISHED")
+    elif not alive:
+        parts.append(f"pid {hb['pid']} DEAD")
+        code = 1
+    elif age > stale_s:
+        parts.append(f"pid {hb['pid']} alive but STALLED? "
+                     f"(> {stale_s:.0f}s since last dispatch)")
+        code = 1
+    else:
+        parts.append(f"pid {hb['pid']} alive")
+    return "  ".join(parts), code
+
+
+def main(argv=None):
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or args[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if args else 2
+    hb_path = args.pop(0)
+    once = "--once" in args
+    if once:
+        args.remove("--once")
+    opts = dict(zip(args[::2], args[1::2]))
+    bad = set(opts) - {"--ledger", "--interval", "--stale"}
+    if bad or len(args) % 2:
+        raise SystemExit(f"unknown/incomplete options: "
+                         f"{sorted(bad) or args[-1:]}")
+    ledger = opts.get("--ledger")
+    interval = float(opts.get("--interval", 5))
+    stale = float(opts.get("--stale", 300))
+    if once:
+        line, code = status_line(hb_path, ledger, stale)
+        print(line)
+        return code
+    while True:
+        line, code = status_line(hb_path, ledger, stale)
+        print(time.strftime("%H:%M:%S") + "  " + line, flush=True)
+        if "FINISHED" in line:
+            return 0
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
