@@ -163,6 +163,169 @@ def _burst_ab(out_path):
     return out
 
 
+def _matmul_ab(out_path):
+    """MXU-native expansion A/B (BENCH round 9): the same micro space
+    checked with guard_matmul ON (guard grid as int8 matmul + one-hot
+    successor einsum) vs OFF (the historical vmapped lane sweep),
+    counts correctness-gated identical, each run carrying the PR-7
+    span recorder so the end-to-end delta attributes per phase.
+
+    On top of the end-to-end rows, two STANDALONE micro-phases time the
+    replaced primitives directly (the engine fuses them inside one jit,
+    so per-phase wall-clock needs standalone dispatch):
+
+    - ``guard_matmul`` vs ``guard_lanes`` spans — the [B, A] guard
+      grid via the packed int8 matmul vs the vmapped per-lane sweep,
+      jitted, on a batch of reachable states;
+    - ``dedup_kernel`` vs ``dedup_probe`` spans — the Pallas
+      probe/claim-insert kernel vs the lax claim walk on a
+      forced-collision key block.  Off-TPU the kernel runs through the
+      Pallas INTERPRETER, so its seconds measure the fallback, not the
+      TPU kernel — the row is labeled honestly, and the outcome
+      equality (outcomes_identical) is the platform-independent part.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tla_tpu.config import Bounds, ModelConfig
+    from raft_tla_tpu.engine.bfs import Engine, U32MAX
+    from raft_tla_tpu.engine.fingerprint import probe_claim_insert_pallas
+    from raft_tla_tpu.obs import Obs, SpanRecorder
+
+    micro = ModelConfig(
+        n_servers=2, init_servers=(0, 1), values=(1,),
+        symmetry=True, max_inflight_override=4,
+        bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                           max_client_requests=1))
+    rows, counts = {}, {}
+    engines = {}
+    for label, gm in (("guard_matmul_off", False),
+                      ("guard_matmul_on", True)):
+        eng = engines[label] = Engine(micro, chunk=256,
+                                      store_states=False,
+                                      guard_matmul=gm)
+        rec = SpanRecorder()
+        obs = Obs(spans=rec)
+        with obs.span("compile"):
+            eng.check(max_depth=2)               # warm the jit caches
+        t0 = time.perf_counter()
+        r = eng.check(obs=obs)
+        secs = time.perf_counter() - t0
+        rows[label] = {
+            "distinct_states": int(r.distinct_states),
+            "depth": int(r.depth),
+            "guard_matmul": int(r.guard_matmul),
+            "dedup_kernel": int(r.dedup_kernel),
+            "levels_fused": int(r.levels_fused),
+            "seconds": round(secs, 2),
+            "states_per_sec": round(
+                r.distinct_states / max(secs, 1e-9), 1),
+            "phase_seconds": {nm: t["seconds"]
+                              for nm, t in rec.totals().items()},
+            "phase_counts": {nm: t["count"]
+                             for nm, t in rec.totals().items()},
+        }
+        counts[label] = (r.distinct_states, r.depth,
+                         tuple(r.level_sizes))
+    identical = counts["guard_matmul_on"] == counts["guard_matmul_off"]
+
+    # ---- standalone guard-pass micro-phase ---------------------------
+    from raft_tla_tpu.models.explore import explore
+    from raft_tla_tpu.ops.codec import encode, widen
+    from raft_tla_tpu.ops.layout import Layout
+    lay = Layout(micro)
+    st = list(explore(micro, max_states=1024,
+                      keep_states=True).states.values())[:256]
+    batch = widen({k: np.stack([encode(lay, sv, h)[k]
+                                for sv, h in st])
+                   for k in encode(lay, *st[0])})
+    svT = {k: jnp.moveaxis(jnp.asarray(v), 0, -1)
+           for k, v in batch.items()}
+    ex_on = engines["guard_matmul_on"].expander
+    ex_off = engines["guard_matmul_off"].expander
+    derT = jax.jit(ex_on.derived_batch_T)(svT)
+    f_on = jax.jit(ex_on.guards_T_matmul)
+    f_off = jax.jit(lambda s, d: ex_off.guards_T(s, d))
+    ok_a = np.asarray(f_on(svT, derT))           # warm + correctness
+    ok_b = np.asarray(f_off(svT, derT))
+    guards_identical = bool((ok_a == ok_b).all())
+    rec2 = SpanRecorder()
+    REPS = 20
+    with rec2.span("guard_matmul"):
+        for _ in range(REPS):
+            f_on(svT, derT)[0].block_until_ready()
+    with rec2.span("guard_lanes"):
+        for _ in range(REPS):
+            f_off(svT, derT)[0].block_until_ready()
+
+    # ---- standalone dedup micro-phase (forced collisions) ------------
+    eng = engines["guard_matmul_on"]
+    W = eng.W
+    rng = np.random.RandomState(11)
+    VCAP, M = 1 << 12, 1 << 10
+    distinct = rng.randint(0, 1 << 32, size=(M // 4, W)) \
+        .astype(np.uint32)
+    keys_np = distinct[rng.randint(0, M // 4, size=M)]
+    keys = tuple(jnp.asarray(keys_np[:, w]) for w in range(W))
+    live = jnp.ones((M,), bool)
+    tbl0 = tuple(jnp.full((VCAP,), U32MAX) for _ in range(W))
+    cl0 = jnp.full((VCAP,), U32MAX)
+    ranks = jnp.arange(M, dtype=jnp.uint32)
+    lax_fn = jax.jit(lambda t, c: eng._probe_insert_lax(
+        t, c, keys, live, ranks))
+    pal_fn = jax.jit(lambda t: probe_claim_insert_pallas(
+        t, keys, live, max_rounds=eng._MAX_PROBE_ROUNDS,
+        interpret=eng._dedup_interpret))
+    outA = lax_fn(tbl0, cl0)                     # warm both
+    outB = pal_fn(tbl0)
+    same = bool(np.array_equal(np.asarray(outA[2]),
+                               np.asarray(outB[1])) and
+                all(np.array_equal(np.asarray(outA[0][w]),
+                                   np.asarray(outB[0][w]))
+                    for w in range(W)))
+    DREPS = 5
+    with rec2.span("dedup_probe"):
+        for _ in range(DREPS):
+            lax_fn(tbl0, cl0)[0][0].block_until_ready()
+    with rec2.span("dedup_kernel"):
+        for _ in range(DREPS):
+            pal_fn(tbl0)[0][0].block_until_ready()
+    micro_phase = {nm: {"seconds": t["seconds"], "count": t["count"]}
+                   for nm, t in rec2.totals().items()}
+
+    plat = jax.default_backend()
+    out = {
+        "bench": "MXU-native expansion A/B with per-phase span totals "
+                 "(bench.py, BENCH_r09 round)",
+        "platform": plat,
+        "honest_label": (
+            "CPU-only fallback: this container has no TPU — the count/"
+            "outcome identities are platform-independent; the seconds "
+            "are XLA:CPU, and the dedup_kernel micro-phase runs the "
+            "Pallas INTERPRETER (the CPU fallback), not the compiled "
+            "TPU kernel" if plat == "cpu" else "TPU-measured"),
+        "status": ("ok" if identical and guards_identical and same else
+                   "FAILED: guard-matmul path diverges from the lane "
+                   "path — the perf rows are meaningless"),
+        "counts_identical": identical,
+        "guard_grid_identical": guards_identical,
+        "dedup_outcomes_identical": same,
+        "rows": rows,
+        "micro_phase_spans": micro_phase,
+        "micro_phase_note": (
+            "guard_matmul/guard_lanes: 20 jitted dispatches of the "
+            "[256-state x lane-grid] guard pass each; dedup_kernel/"
+            "dedup_probe: 5 dispatches of a 1024-key forced-collision "
+            "claim-insert against a 4096-slot table each"),
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, out_path)
+    return out
+
+
 def _no_reference_fallback():
     """Containers without the reference checkout (and without the TPU)
     cannot run the headline metric at all — emit ONE honestly-labeled
@@ -223,6 +386,11 @@ def _no_reference_fallback():
     # the burst A/B is correctness-gated like the spill A/B: a
     # burst≡per-level mismatch fails the shared gate, not just the file
     gate_ok = gate_ok and burst_ab["counts_identical"]
+    # round 9: the MXU-path A/B (guard matmul + dedup kernel) rides the
+    # SAME shared correctness gate
+    matmul_ab = _matmul_ab(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r09.json"))
+    gate_ok = gate_ok and matmul_ab["status"] == "ok"
     print(json.dumps({
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
         "value": None, "unit": "states/sec", "vs_baseline": None,
@@ -237,7 +405,13 @@ def _no_reference_fallback():
                            burst_ab["counts_identical"],
                        "dispatches_per_level": {
                            k: v["dispatches_per_level"]
-                           for k, v in burst_ab["rows"].items()}}}}))
+                           for k, v in burst_ab["rows"].items()}},
+                   "matmul_ab": {
+                       "written_to": "BENCH_r09.json",
+                       "status": matmul_ab["status"],
+                       "states_per_sec": {
+                           k: v["states_per_sec"]
+                           for k, v in matmul_ab["rows"].items()}}}}))
 
 
 def main():
@@ -332,6 +506,9 @@ def main():
     burst_ab = _burst_ab(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_r08.json"))
     gate_ok = gate_ok and burst_ab["counts_identical"]
+    matmul_ab = _matmul_ab(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r09.json"))
+    gate_ok = gate_ok and matmul_ab["status"] == "ok"
 
     # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------
     # Only meaningful for the full-depth run on the recorded machine
@@ -379,6 +556,7 @@ def main():
     }
     out["detail"]["burst_ab_counts_identical"] = \
         bool(burst_ab["counts_identical"])
+    out["detail"]["matmul_ab_status"] = matmul_ab["status"]
     print(json.dumps(out))
 
 

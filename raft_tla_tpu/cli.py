@@ -264,7 +264,18 @@ def cmd_check(args):
                   f"{args.burst_levels}); use --no-burst to disable "
                   "the fused-level path", file=sys.stderr)
             return 2
-        burst_kw = dict(burst=args.burst, burst_levels=args.burst_levels)
+        fam_density = None
+        if args.fam_cap_density:
+            from .engine.expand import parse_fam_density
+            try:
+                fam_density = parse_fam_density(args.fam_cap_density)
+            except ValueError as e:
+                print(f"--fam-cap-density: {e}", file=sys.stderr)
+                return 2
+        burst_kw = dict(burst=args.burst, burst_levels=args.burst_levels,
+                        guard_matmul=args.guard_matmul,
+                        dedup_kernel=args.dedup_kernel,
+                        fam_density=fam_density)
         if args.spill:
             # host-spill engine: levels stream through host RAM, for
             # depths whose level buffers exceed HBM (engine/spill);
@@ -447,7 +458,8 @@ def cmd_trace(args):
             _write_seed(args.emit_seed, state_to_obj(v.state, v.hist))
         return 0
     from .engine.bfs import Engine
-    eng = Engine(cfg, chunk=args.chunk, store_states=True)
+    eng = Engine(cfg, chunk=args.chunk, store_states=True,
+                 guard_matmul=args.guard_matmul)
     r = eng.check(max_depth=args.max_depth, max_states=args.max_states,
                   stop_on_violation=True, verbose=args.verbose)
     if not r.violations:
@@ -496,7 +508,8 @@ def cmd_simulate(args):
     import jax
     from .sim import SimEngine
     kw = dict(max_depth=depth, seed=args.seed, policy=args.policy,
-              bloom_bits=args.bloom_bits)
+              bloom_bits=args.bloom_bits,
+              guard_matmul=args.guard_matmul)
     if args.mesh and len(jax.local_devices()) > 1:
         from .parallel.sim_mesh import ShardedSimEngine
         eng = ShardedSimEngine(cfg, walkers=args.walkers, **kw)
@@ -585,6 +598,16 @@ def main(argv=None):
         sp.add_argument("--max-client-requests", type=int, default=None)
         sp.add_argument("--max-restarts", type=int, default=None)
         sp.add_argument("--fp128", action="store_true")
+        sp.add_argument("--guard-matmul",
+                        action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="MXU-native expansion (default ON, "
+                             "bit-exact): the guard grid runs as one "
+                             "int8 matmul against the packed guard "
+                             "matrix and enabled-lane materialization "
+                             "as one-hot einsum blocks; --no-guard-"
+                             "matmul restores the vmapped per-lane "
+                             "sweep exactly")
         sp.add_argument("--verbose", "-v", action="store_true")
 
     pc = sub.add_parser("check", help="exhaustive bounded check")
@@ -632,6 +655,23 @@ def main(argv=None):
                     metavar="K",
                     help="max levels fused per burst device call "
                          "(default 16)")
+    pc.add_argument("--dedup-kernel", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="Pallas probe/claim-insert dedup kernel "
+                         "(engine/fingerprint): 'auto' engages it on "
+                         "TPU only; 'on' forces it everywhere (runs "
+                         "through the Pallas interpreter off-TPU — "
+                         "slow, for differential testing); 'off' "
+                         "keeps the lax gather/scatter sequence. "
+                         "Outcomes are bit-identical in every mode")
+    pc.add_argument("--fam-cap-density", default=None, metavar="SPEC",
+                    help="override per-family enabled-lane density "
+                         "caps as fam=k,fam2=k2 (e.g. "
+                         "Receive=8,Timeout=2): cap_f = chunk * "
+                         "min(lanes_f, k).  Tunes cap-overflow "
+                         "replays without editing engine/expand.py; "
+                         "unknown families / non-positive k are "
+                         "rejected with a clear error")
     pc.add_argument("--stats-json", default=None, metavar="FILE",
                     help="write the run stats JSON (incl. "
                          "levels_fused/burst_bailouts) to FILE")

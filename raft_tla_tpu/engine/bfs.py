@@ -160,7 +160,8 @@ class CheckResult:
                  phase_seconds: Optional[Dict[str, float]] = None,
                  violations_global: int = 0, levels_fused: int = 0,
                  burst_dispatches: int = 0, burst_bailouts: int = 0,
-                 pin_interior_states: int = 0):
+                 pin_interior_states: int = 0, guard_matmul: int = 0,
+                 dedup_kernel: int = 0):
         from ..obs.metrics import MetricsRegistry
         init = locals()
         self.metrics = MetricsRegistry()
@@ -391,7 +392,10 @@ class Engine:
                  incremental_fp: bool = True,
                  burst: bool = True,
                  burst_levels: Optional[int] = None,
-                 archive_dir: Optional[str] = None):
+                 archive_dir: Optional[str] = None,
+                 guard_matmul: bool = True,
+                 dedup_kernel: str = "auto",
+                 fam_density: Optional[Dict[str, int]] = None):
         enable_persistent_compilation_cache()
         self.cfg = cfg
         # observability bundle (obs/): check() rebinds it per run; the
@@ -415,7 +419,28 @@ class Engine:
         self.incremental_fp = incremental_fp
         self.lay = Layout(cfg)
         self.kern = RaftKernels(self.lay)
-        self.expander = Expander(cfg)
+        # MXU-native expansion (guard grid as int8 matmul + one-hot
+        # einsum selection — expand.Expander docstring): default ON,
+        # bit-exact by construction; guard_matmul=False restores the
+        # historical vmapped-sweep program exactly
+        self.guard_matmul = bool(guard_matmul)
+        self.expander = Expander(cfg, guard_matmul=self.guard_matmul)
+        # Pallas probe/claim dedup kernel (fingerprint.py): "auto"
+        # engages it on TPU only (the gather/scatter lax sequence stays
+        # the CPU program — the kernel's interpret=True fallback exists
+        # so CPU tier-1 and the oracle differentials can still exercise
+        # it, via "on"); guard_matmul=False forces the whole MXU path
+        # off, the kernel included.
+        if dedup_kernel not in ("auto", "on", "off"):
+            raise ValueError(
+                f"dedup_kernel must be 'auto', 'on' or 'off' "
+                f"(got {dedup_kernel!r})")
+        self.dedup_kernel = dedup_kernel
+        plat = jax.default_backend()
+        self._dedup_pallas = self.guard_matmul and (
+            dedup_kernel == "on" or
+            (dedup_kernel == "auto" and plat == "tpu"))
+        self._dedup_interpret = plat != "tpu"
         self.fpr = Fingerprinter(cfg)
         self.preds = Predicates(self.lay)
         self.inv_names = list(cfg.invariants)
@@ -457,8 +482,12 @@ class Engine:
                 f"allocates {self.VCAP * self.W * 4} bytes",
                 stacklevel=2)
         # per-family materialization caps (guard-first expansion);
-        # static jit args so growth retraces the step
-        self.FAM_CAPS = tuple(self.expander.default_fam_caps(self.chunk))
+        # static jit args so growth retraces the step.  fam_density
+        # overrides the measured per-family densities (validated —
+        # a bad entry raises here, not as a jit traceback)
+        self.fam_density = dict(fam_density or {})
+        self.FAM_CAPS = tuple(self.expander.default_fam_caps(
+            self.chunk, self.fam_density))
         self._rehash_cache = {}
         self._phase1 = jax.jit(self._phase1_impl)
         self._phase2 = jax.jit(self._phase2_impl)
@@ -564,6 +593,29 @@ class Engine:
         return (h & jnp.uint32(vcap - 1)).astype(jnp.int32)
 
     def _probe_insert(self, table, claims, keys, live, ranks):
+        """Claim-insert dispatch: the Pallas probe/claim kernel
+        (engine/fingerprint.probe_claim_insert_pallas — one fused
+        kernel walking probe → compare → claim per lane, no XLA
+        gather/scatter round trips) when the MXU dedup path is active,
+        else the historical lax formulation (_probe_insert_lax).
+
+        Contract for the kernel path: every caller passes ``ranks``
+        ascending with lane index (they all pass jnp.arange), which
+        makes the kernel's sequential index-order processing exactly
+        the lax path's rank tie-break — bit-identical outcomes
+        (tests/test_guard_matmul.py pins it on forced-collision
+        fixtures)."""
+        if self._dedup_pallas:
+            from .fingerprint import probe_claim_insert_pallas
+            with jax.named_scope("dedup_kernel"):
+                table, fresh, pos, hovf = probe_claim_insert_pallas(
+                    table, keys, live,
+                    max_rounds=self._MAX_PROBE_ROUNDS,
+                    interpret=self._dedup_interpret)
+            return table, claims, fresh, pos, hovf
+        return self._probe_insert_lax(table, claims, keys, live, ranks)
+
+    def _probe_insert_lax(self, table, claims, keys, live, ranks):
         """Parallel claim-insert of `keys` (W × u32[M]; lanes with
         live=False are ignored) into the open-addressing `table`
         (W × u32[VCAP]; `claims` u32[VCAP] all-U32MAX between calls).
@@ -693,7 +745,10 @@ class Engine:
                             for _ in range(self.W))
                 ncl = jnp.full((new_vcap,), U32MAX)
                 ranks = jnp.arange(old_vcap, dtype=jnp.uint32)
-                new, ncl, _fresh, _pos, hv = self._probe_insert(
+                # always the lax path: a rehash probes old_vcap lanes
+                # at once — not the per-candidate hot loop the Pallas
+                # kernel exists for
+                new, ncl, _fresh, _pos, hv = self._probe_insert_lax(
                     new, ncl, table, ~allones, ranks)
                 return new, ncl, hv
             fn = self._rehash_cache[(old_vcap, new_vcap)] = jax.jit(impl)
@@ -1041,7 +1096,8 @@ class Engine:
         return self._burst_chunks * self.chunk
 
     def _burst_core(self, vis, claims, fr, fm, gd, nf, g0, pg0,
-                    fam_caps, levels_left, states_cap, fcap=None):
+                    fam_caps, levels_left, states_cap, fcap=None,
+                    ocap=None):
         """The fused multi-level loop, over standalone ring-width
         buffers (no engine carry): fr/fm/gd are [..., KB]/[KB]/[KB]
         frontier rows (narrow, batch-last), membership mask and global
@@ -1062,6 +1118,12 @@ class Engine:
         VCAP = vis[0].shape[0]
         L_MAX = self.burst_levels
         n_inv = len(self.inv_names)
+        # post-dedup compaction width (capped by the ring: a chunk can
+        # never append more than KB rows anyway) — see the OCAP note in
+        # the chunk step; the burst body used to run narrow/phase2 at
+        # FCAP width per chunk where the per-level path compacts to
+        # OCAP first, a measured ~2x per-chunk saving
+        OC = min(int(ocap) if ocap is not None else self.OCAP, KB)
 
         st = dict(
             vis=vis, claims=claims, fr=fr, fm=fm, gd=gd, nf=nf,
@@ -1107,6 +1169,10 @@ class Engine:
             bail = bail | hv
             n_fresh = fresh.sum(dtype=jnp.int32)
             bail = bail | (nl + n_fresh > KB)
+            # one chunk's fresh rows outran the post-dedup compaction
+            # buffer: bail to the per-level path, whose oovf growth
+            # machinery owns this case
+            bail = bail | (n_fresh > OC)
             # bail => this level never happened: clear THIS chunk's
             # inserts on the spot and the level's earlier chunks' via
             # the ring journal (rollback-safe — _probe_insert note)
@@ -1123,24 +1189,38 @@ class Engine:
             gl2 = st["gl"] + n_genl
             nl2 = nl + n_fresh
 
-            # scatter the fresh rows into the level ring at
-            # [nl, nl + n_fresh) (candidate-slot ascending =
-            # parent-major, lane ascending — the per-level order)
-            lpos = jnp.where(
-                fresh, nl + jnp.cumsum(fresh.astype(jnp.int32)) - 1, KB)
-            rows_n = narrow(self.lay, cand_c)
-            lv = {k: st["lv"][k].at[..., lpos].set(rows_n[k],
+            # second compaction (the chunk step's OCAP discipline,
+            # folded in round 9): fresh FCAP slots compact to OC rows
+            # BEFORE narrow/phase2/ring-append, so the burst body never
+            # pays padded FCAP width for the append-side work.  Row
+            # order is candidate-slot ascending = parent-major, lane
+            # ascending — the per-level order, bit-identical appends.
+            slot = jnp.arange(FCAP, dtype=jnp.int32)
+            opos = jnp.where(fresh,
+                             jnp.cumsum(fresh.astype(jnp.int32)) - 1,
+                             OC)
+            oidx = lax.optimization_barrier(
+                jnp.zeros((OC,), jnp.int32).at[opos].set(
+                    slot, mode="drop"))          # out row -> FCAP slot
+            rows = lax.optimization_barrier(
+                {k: cand_c[k][..., oidx] for k in cand_c})
+            inv, con = self._phase2_T(rows)
+            rows_n = narrow(self.lay, rows)
+            # ring positions for the compacted rows: nl + row index
+            oar = jnp.arange(OC, dtype=jnp.int32)
+            rpos = jnp.where(oar < n_fresh, nl + oar, KB)
+            lv = {k: st["lv"][k].at[..., rpos].set(rows_n[k],
                                                    mode="drop")
                   for k in st["lv"]}
-            par_row = jnp.clip(base + take // A, 0, KB - 1)
+            take_o = take[oidx]
+            par_row = jnp.clip(base + take_o // A, 0, KB - 1)
             pgid = st["gd"][par_row]
-            lvp = st["lvp"].at[lpos].set(pgid, mode="drop")
-            lvlane = st["lvlane"].at[lpos].set(take % A, mode="drop")
-            jsl = st["jsl"].at[lpos].set(pos, mode="drop")
-            inv, con = self._phase2_T(cand_c)
-            lin = (st["lin"].at[:, lpos].set(inv, mode="drop")
+            lvp = st["lvp"].at[rpos].set(pgid, mode="drop")
+            lvlane = st["lvlane"].at[rpos].set(take_o % A, mode="drop")
+            jsl = st["jsl"].at[rpos].set(pos[oidx], mode="drop")
+            lin = (st["lin"].at[:, rpos].set(inv, mode="drop")
                    if n_inv else st["lin"])
-            lco = st["lco"].at[lpos].set(con, mode="drop")
+            lco = st["lco"].at[rpos].set(con, mode="drop")
 
             new_base = base + B
             level_done = ~bail & (new_base >= st["nf"])
@@ -1239,7 +1319,7 @@ class Engine:
             carry["vis"], carry["claims"], front0,
             carry["fmask"][:KB], gd0, carry["n_front"], carry["g_off"],
             carry["pg_off"], fam_caps, levels_left, states_cap,
-            fcap=carry["cidx"].shape[0])
+            fcap=carry["cidx"].shape[0], ocap=carry["oidx"].shape[0])
         fmask = jnp.zeros_like(carry["fmask"]).at[:KB].set(stf["fm"])
         front = {k: lax.dynamic_update_slice_in_dim(
                      v, stf["fr"][k], 0, axis=v.ndim - 1)
@@ -1315,6 +1395,33 @@ class Engine:
         return new
 
     # ------------------------------------------------------------------
+
+    def _stamp_mode(self, res: "CheckResult") -> "CheckResult":
+        """Record which expansion/dedup program this run executed (the
+        MXU-path mode flags in the metrics registry).  Stamped from the
+        LIVE engine config — never serialized into checkpoints — so a
+        resumed run reports the resuming engine's modes."""
+        res.guard_matmul = int(self.guard_matmul)
+        res.dedup_kernel = int(self._dedup_pallas)
+        return res
+
+    def _prewarm_perlevel(self):
+        """Warm the per-level step/finalize executables with one dummy
+        dispatch each BEFORE the driver loop (the BENCH_r08 recompile
+        leak: with burst ON the first per-level dispatch otherwise
+        happens only when a burst BAILS, so its cold compile landed
+        mid-run inside a level_dispatch span — 11.6 s over 9 dispatches
+        vs 1.65 s over 30 in per-level mode).  The dummy carry is empty
+        (n_front = 0: every lane invalid, nothing inserted) and donated
+        away by the calls, so the cost is one transient carry
+        allocation + two no-op dispatches; post-bail dispatches then
+        reuse the warmed executable (tests/test_obs.py pins the
+        compile-span/cache counts).  Capacity growth retraces, as
+        ever."""
+        dummy = self._fresh_carry(self.LCAP, self.VCAP)
+        dummy = self._step_jit(dummy, self.FAM_CAPS)
+        dummy, _out = self._fin_jit(dummy)
+        del dummy
 
     def _dedup_roots(self, seed_states):
         """Shared root-admission front half (this engine, ShardedEngine
@@ -1426,8 +1533,30 @@ class Engine:
         t0 = time.perf_counter()
         lay = self.lay
 
+        def prewarm(obs):
+            # per-level executables warm at run start, inside a compile
+            # span — never mid-run inside a level_dispatch span (the
+            # BENCH_r08 burst-bailout leak).  Gated on span
+            # instrumentation: every real perf/TPU run carries the obs
+            # surface (ROADMAP carry-over; bench/deep_run/obs_smoke all
+            # pass spans), while uninstrumented unit-test checks skip
+            # the two extra dummy dispatches — on XLA:CPU the
+            # persistent compile cache cannot absorb them, and tier-1
+            # runs ~100 check() calls.  Called BEFORE the real carry
+            # materializes where possible: the dummy carry is donated
+            # away by the warm dispatches, so sequencing it first keeps
+            # peak device memory at ONE carry.
+            if obs.spans is not None:
+                with obs.span("compile"):
+                    self._prewarm_perlevel()
+
         if resume_from is not None:
             carry, res, meta = self._load_checkpoint(resume_from)
+            # resume: the checkpointed carry is already device-resident
+            # before the capacities are known, so this prewarm runs
+            # beside it — a transient second carry allocation (resumes
+            # are rare; a fresh start never pays it)
+            prewarm(obs)
             n_states = meta["n_states"]
             n_vis = meta["n_vis"]
             depth = meta["depth"]
@@ -1446,6 +1575,8 @@ class Engine:
             while n_roots + self.LCAP - self.OCAP > \
                     self._LOAD_MAX * self.VCAP:
                 self.VCAP *= 4
+            # capacities final; warm BEFORE the real carry allocates
+            prewarm(obs)
             carry = self._fresh_carry(self.LCAP, self.VCAP)
             # roots enter through the same admit path as every level:
             # place them in the level buffer + visited table (host-side
@@ -1478,6 +1609,7 @@ class Engine:
             n_vis = 0
             depth = 0
             resumed = False
+        self._stamp_mode(res)
         t_dev = 0.0
 
         def run_finalize(carry):

@@ -120,16 +120,157 @@ _FAMILY_DENSITY = {
 }
 
 
-class Expander:
-    """Compiled expansion over a frontier batch."""
+def validate_fam_density(density) -> Dict[str, int]:
+    """Bounds-validate a per-family density override mapping (the
+    engines' ``fam_density`` kwarg / CLI ``--fam-cap-density``): known
+    family name, integer k >= 1.  Raises ValueError with a message fit
+    for the CLI — never a jit traceback."""
+    out = {}
+    for name, k in dict(density or {}).items():
+        if name not in _FAMILY_DENSITY:
+            raise ValueError(
+                f"unknown action family {name!r} in fam-cap-density; "
+                f"known families: {', '.join(sorted(_FAMILY_DENSITY))}")
+        if isinstance(k, bool) or not isinstance(k, int):
+            raise ValueError(
+                f"fam-cap-density {name}: k must be an integer "
+                f"(got {k!r})")
+        if k < 1:
+            raise ValueError(
+                f"fam-cap-density {name}: k must be >= 1 (got {k}) — "
+                "a zero cap would drop every enabled lane of the "
+                "family")
+        out[name] = k
+    return out
 
-    def __init__(self, cfg: ModelConfig):
+
+def parse_fam_density(spec: str) -> Dict[str, int]:
+    """Parse the CLI form ``fam=k,fam2=k2`` (``--fam-cap-density``)
+    into a validated override dict."""
+    out = {}
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, sep, val = item.partition("=")
+        if not sep:
+            raise ValueError(
+                f"fam-cap-density entry {item!r} is not of the form "
+                "fam=k (e.g. Receive=8,Timeout=2)")
+        try:
+            k = int(val.strip())
+        except ValueError:
+            raise ValueError(
+                f"fam-cap-density {name.strip()}: k must be an "
+                f"integer, got {val.strip()!r}") from None
+        out[name.strip()] = k
+    return validate_fam_density(out)
+
+
+class Expander:
+    """Compiled expansion over a frontier batch.
+
+    guard_matmul — the MXU-native expansion path (default ON, bit-exact
+    by construction): the [states × lanes] guard grid is computed as
+    one int8 matmul of per-state guard features against a packed
+    signed-weight matrix (``guards_T_matmul``) instead of the vmapped
+    per-lane kernel sweep, and the compacted (row, lane) selections in
+    ``materialize``/``step_lanes`` become one-hot einsum blocks (the
+    BLEST/tensor-core-BFS formulation: frontier expansion as low-
+    precision matrix products).  OFF restores the exact historical
+    gather/vmap program — tests/test_guard_matmul.py pins ON ≡ OFF."""
+
+    def __init__(self, cfg: ModelConfig, guard_matmul: bool = True):
         self.cfg = cfg
         self.lay = Layout(cfg)
         self.kern = RaftKernels(self.lay)
         self.families = build_families(self.lay)
         self.n_lanes = sum(f.n_lanes for f in self.families)
+        self.guard_matmul = bool(guard_matmul)
+        self._gW, self._gT = self._build_guard_matrix()
         self._expand = jax.jit(self._expand_impl)
+
+    # ---- packed guard matrix (the guard grid as int8 matmul) -------------
+
+    def _build_guard_matrix(self):
+        """(W int8 [n_features, A], T int32 [A]): lane a's enabling
+        guard is exactly ``φ(s) · W[:, a] == T[a]`` over the feature
+        vector of ops/kernels.guard_features.
+
+        Guards that are pure conjunctions of features select them with
+        +1 weights and threshold = the conjunct count; a negated
+        conjunct (AddNewServer's ``j ∉ config``) enters with weight -1
+        and no threshold contribution — integer arithmetic, so the
+        compare is exact, never approximate.  A family without a row
+        here fails loudly: new actions must declare their guard
+        algebra, silently falling back would fork the two paths."""
+        from ..ops.kernels import guard_feature_offsets
+        OFF = guard_feature_offsets(self.lay)
+        S = self.lay.S
+        Wm = np.zeros((OFF["total"], self.n_lanes), np.int8)
+        T = np.zeros((self.n_lanes,), np.int32)
+        lane = 0
+        for fam in self.families:
+            lanes = list(zip(*fam.params))
+            for vals in lanes:
+                vals = tuple(int(v) for v in vals)
+                if fam.name == "RequestVote":
+                    i, j = vals
+                    Wm[OFF["cand"] + i, lane] = 1
+                    Wm[OFF["needvote"] + i * S + j, lane] = 1
+                    T[lane] = 2
+                elif fam.name == "BecomeLeader":
+                    (i,) = vals
+                    Wm[OFF["cand"] + i, lane] = 1
+                    Wm[OFF["blq"] + i, lane] = 1
+                    T[lane] = 2
+                elif fam.name in ("ClientRequest", "AdvanceCommitIndex"):
+                    i = vals[0]
+                    Wm[OFF["leader"] + i, lane] = 1
+                    T[lane] = 1
+                elif fam.name == "AppendEntries":
+                    i, j = vals
+                    Wm[OFF["leader"] + i, lane] = 1
+                    Wm[OFF["cfg"] + i * S + j, lane] = 1
+                    T[lane] = 2
+                elif fam.name == "Timeout":
+                    (i,) = vals
+                    Wm[OFF["folc"] + i, lane] = 1
+                    Wm[OFF["cfg"] + i * S + i, lane] = 1
+                    T[lane] = 2
+                elif fam.name == "Restart":
+                    T[lane] = 0          # unconditionally enabled
+                elif fam.name == "UpdateTerm":
+                    Wm[OFF["ut"] + vals[0], lane] = 1
+                    T[lane] = 1
+                elif fam.name == "CocDiscard":
+                    Wm[OFF["cocd"] + vals[0], lane] = 1
+                    T[lane] = 1
+                elif fam.name == "Receive":
+                    Wm[OFF["recv"] + vals[0], lane] = 1
+                    T[lane] = 1
+                elif fam.name in ("Duplicate", "Drop"):
+                    Wm[OFF["cnt1"] + vals[0], lane] = 1
+                    T[lane] = 1
+                elif fam.name == "AddNewServer":
+                    i, j = vals
+                    Wm[OFF["leader"] + i, lane] = 1
+                    Wm[OFF["cfg"] + i * S + j, lane] = -1   # j ∉ config
+                    T[lane] = 1
+                elif fam.name == "DeleteServer":
+                    i, j = vals
+                    Wm[OFF["leader"] + i, lane] = 1
+                    Wm[OFF["folc"] + j, lane] = 1
+                    Wm[OFF["cfg"] + i * S + j, lane] = 1
+                    T[lane] = 3
+                else:
+                    raise KeyError(
+                        f"no guard-matrix row for family {fam.name!r} "
+                        "— declare its guard algebra in "
+                        "Expander._build_guard_matrix")
+                lane += 1
+        assert lane == self.n_lanes
+        return Wm, T
 
     def lane_labels(self) -> List[str]:
         out = []
@@ -175,9 +316,17 @@ class Expander:
     # family kernel runs on those rows, and an index map reassembles the
     # global FCAP candidate buffer in the oracle's enumeration order.
 
-    def default_fam_caps(self, chunk: int) -> Tuple[int, ...]:
+    def default_fam_caps(self, chunk: int,
+                         density=None) -> Tuple[int, ...]:
+        """Per-family materialization caps: chunk × min(lanes, density).
+        ``density`` overrides _FAMILY_DENSITY per family (the engines'
+        ``fam_density`` kwarg / ``--fam-cap-density`` — validated by
+        validate_fam_density, so cap-overflow replays are tunable
+        without editing this module)."""
+        d = dict(_FAMILY_DENSITY)
+        d.update(validate_fam_density(density))
         return tuple(
-            chunk * min(f.n_lanes, _FAMILY_DENSITY.get(f.name, 2))
+            chunk * min(f.n_lanes, d.get(f.name, 2))
             for f in self.families)
 
     def derived_batch_T(self, svT):
@@ -197,10 +346,60 @@ class Expander:
 
     def guards_T(self, svT, derT) -> jnp.ndarray:
         """Batch-LAST frontier [..., B] -> ok [B, A]: every lane's
-        enabling guard, with the successor construction
-        dead-code-eliminated."""
+        enabling guard.  Dispatches to the MXU guard-matrix path
+        (``guards_T_matmul``, default) or the historical vmapped
+        per-lane sweep with the successor construction
+        dead-code-eliminated (``guard_matmul=False``)."""
+        if self.guard_matmul:
+            return self.guards_T_matmul(svT, derT)
         ok = jax.vmap(self._guard_one, in_axes=-1, out_axes=-1)(svT, derT)
         return jnp.moveaxis(ok, -1, 0)
+
+    def guards_T_matmul(self, svT, derT) -> jnp.ndarray:
+        """The guard grid as ONE int8 matmul: φ [F, B] features (one
+        elementwise extraction pass per state — the per-slot receive
+        guards run once per SLOT, not once per lane) contracted against
+        the packed weight matrix on the MXU with int32 accumulation,
+        then the exact per-lane threshold compare.  Bit-identical to
+        the lane sweep by construction (integer arithmetic, 0/±1
+        weights)."""
+        with jax.named_scope("guard_matmul"):
+            phi = jax.vmap(self.kern.guard_features,
+                           in_axes=-1, out_axes=-1)(svT, derT)  # [F, B]
+            acc = jax.lax.dot_general(
+                phi, jnp.asarray(self._gW),
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)               # [B, A]
+            return acc == jnp.asarray(self._gT)[None, :]
+
+    # ---- one-hot einsum selection (the successor-generation half of
+    # the MXU path): a compacted (row, lane) index block becomes an
+    # int one-hot matrix contracted against the batch — a single-1-per-
+    # row matmul is EXACTLY the gather (one nonzero product per output
+    # element, int32 accumulation), but it rides the MXU instead of the
+    # scalar gather units.  uint32 payloads bitcast through int32.
+
+    def _sel_rows(self, arrs, b_idx, B: int):
+        sel = (b_idx[:, None] ==
+               jnp.arange(B, dtype=jnp.int32)[None, :]) \
+            .astype(jnp.int32)                            # [cap, B]
+        out = {}
+        for k, v in arrs.items():
+            isu = v.dtype == jnp.uint32
+            vi = jax.lax.bitcast_convert_type(v, jnp.int32) if isu else v
+            r = jnp.einsum("...b,cb->...c", vi, sel,
+                           preferred_element_type=jnp.int32)
+            out[k] = jax.lax.bitcast_convert_type(r, jnp.uint32) \
+                if isu else r
+        return out
+
+    def _sel_params(self, params, l_idx, nf: int):
+        sel = (l_idx[:, None] ==
+               jnp.arange(nf, dtype=jnp.int32)[None, :]) \
+            .astype(jnp.int32)                            # [cap, nf]
+        return [jnp.einsum("cn,n->c", sel, jnp.asarray(p, jnp.int32),
+                           preferred_element_type=jnp.int32)
+                for p in params]
 
     def materialize(self, svT, derT, okf, epos, fcap: int,
                     fam_caps, delta_fp=None) \
@@ -285,9 +484,17 @@ class Expander:
             lo = int(coff_np[fi])
             b_idx = b_all[lo:lo + cap]
             l_idx = jnp.clip(l_all[lo:lo + cap] - off, 0, nf - 1)
-            sv_rows = {k: v[..., b_idx] for k, v in svT.items()}
-            der_rows = {k: v[..., b_idx] for k, v in derT.items()}
-            prm_rows = [jnp.asarray(p)[l_idx] for p in fam.params]
+            if self.guard_matmul:
+                # batched successor einsum: the family's compacted
+                # (row, lane) block selects parent rows and lane params
+                # via one-hot matmuls (exact — see _sel_rows)
+                sv_rows = self._sel_rows(svT, b_idx, B)
+                der_rows = self._sel_rows(derT, b_idx, B)
+                prm_rows = self._sel_params(fam.params, l_idx, nf)
+            else:
+                sv_rows = {k: v[..., b_idx] for k, v in svT.items()}
+                der_rows = {k: v[..., b_idx] for k, v in derT.items()}
+                prm_rows = [jnp.asarray(p)[l_idx] for p in fam.params]
             _ok, sv2 = jax.vmap(
                 fam.fn, in_axes=(-1, -1) + (0,) * len(fam.params),
                 out_axes=(0, -1))(sv_rows, der_rows, *prm_rows)
@@ -330,7 +537,9 @@ class Expander:
         for fam in self.families:
             nf = fam.n_lanes
             li = jnp.clip(lane - off, 0, nf - 1)
-            prm = [jnp.asarray(p)[li] for p in fam.params]
+            prm = (self._sel_params(fam.params, li, nf)
+                   if self.guard_matmul
+                   else [jnp.asarray(p)[li] for p in fam.params])
             _ok, sv2 = jax.vmap(
                 fam.fn, in_axes=(-1, -1) + (0,) * len(fam.params),
                 out_axes=(0, -1))(svT, derT, *prm)

@@ -97,7 +97,10 @@ class SpillEngine(Engine):
                  dev_keys: Optional[int] = None,
                  burst: bool = True,
                  burst_levels: Optional[int] = None,
-                 archive_dir: Optional[str] = None):
+                 archive_dir: Optional[str] = None,
+                 guard_matmul: bool = True,
+                 dedup_kernel: str = "auto",
+                 fam_density: Optional[Dict[str, int]] = None):
         # burst (fused multi-level dispatch) is ON by default since
         # round 8 — the tiny early levels of a deep spill run pay the
         # same tunneled dispatch floor as the classic engine's; pass
@@ -106,7 +109,10 @@ class SpillEngine(Engine):
         super().__init__(cfg, chunk=chunk, store_states=store_states,
                          lcap=seg, vcap=vcap, fcap=fcap, ocap=ocap,
                          burst=burst, burst_levels=burst_levels,
-                         archive_dir=archive_dir)
+                         archive_dir=archive_dir,
+                         guard_matmul=guard_matmul,
+                         dedup_kernel=dedup_kernel,
+                         fam_density=fam_density)
         self.SEGL = self.LCAP          # level segment rows (can grow)
         self.SEGF = self.LCAP          # frontier segment rows (fixed)
         self.sync_every = max(1, int(sync_every))
@@ -153,7 +159,7 @@ class SpillEngine(Engine):
         # an FCAP growth must force a retrace explicitly.
         self._spill_burst_jit = jax.jit(self._spill_burst_call,
                                         donate_argnums=(0, 1),
-                                        static_argnums=(7, 8))
+                                        static_argnums=(7, 8, 9))
 
     # ------------------------------------------------------------------
     # fused per-chunk step (spill twin of Engine._chunk_step_impl)
@@ -318,6 +324,14 @@ class SpillEngine(Engine):
         if self.host_table:
             carry["lfp"] = jnp.full((self.W, self.SEGL), U32MAX)
         return carry
+
+    def _prewarm_perlevel(self):
+        """Spill twin of Engine._prewarm_perlevel: one dummy streamed
+        chunk step on an empty spill carry warms the executable the
+        segment driver falls back to when a burst bails."""
+        dummy = self._fresh_spill_carry()
+        dummy, _s = self._sstep_jit(dummy, self.FAM_CAPS)
+        del dummy
 
     # ------------------------------------------------------------------
     # host-side level plumbing
@@ -626,7 +640,11 @@ class SpillEngine(Engine):
                 live = jnp.arange(nq, dtype=jnp.int32) < n
                 ks = tuple(keys[w] for w in range(self.W))
                 ranks = jnp.arange(nq, dtype=jnp.uint32)
-                table, claims, _f, _p, hv = self._probe_insert(
+                # lax path unconditionally: the reseed bulk-inserts a
+                # whole frontier cohort at once — not the per-candidate
+                # hot loop the sequential Pallas kernel exists for
+                # (same discipline as the rehash sites)
+                table, claims, _f, _p, hv = self._probe_insert_lax(
                     table, claims, ks, live, ranks)
                 return table, claims, hv
             fn = self._seed_cache[(self.VCAP, nq)] = jax.jit(impl)
@@ -651,10 +669,11 @@ class SpillEngine(Engine):
     # ------------------------------------------------------------------
 
     def _spill_burst_call(self, vis, claims, fr, fm, gd, nf, g0,
-                          fam_caps, fcap, levels_left, states_cap):
+                          fam_caps, fcap, ocap, levels_left,
+                          states_cap):
         stf, out = self._burst_core(vis, claims, fr, fm, gd, nf, g0,
                                     g0, fam_caps, levels_left,
-                                    states_cap, fcap=fcap)
+                                    states_cap, fcap=fcap, ocap=ocap)
         return (stf["vis"], stf["claims"], stf["fr"], stf["fm"],
                 stf["gd"], stf["nf"], out)
 
@@ -698,7 +717,7 @@ class SpillEngine(Engine):
                     {k: jnp.asarray(v) for k, v in fr_np.items()},
                     jnp.asarray(fm_np), jnp.asarray(gd_np),
                     jnp.int32(n_front), jnp.int32(n_states),
-                    self.FAM_CAPS, self.FCAP,
+                    self.FAM_CAPS, self.FCAP, self.OCAP,
                     jnp.int32(lv_left), jnp.int32(st_cap))
             carry = dict(carry, vis=vis, claims=claims)
             stats = np.asarray(out["stats"])      # the ONE burst sync
@@ -796,9 +815,20 @@ class SpillEngine(Engine):
         lay = self.lay
         frontier_keys: List[np.ndarray] = []   # host-table mode only
 
+        def prewarm():
+            # the segment driver's streamed step warms at run start so
+            # a burst BAIL never pays its cold compile mid-run inside a
+            # dispatch span (the BENCH_r08 leak — engine/bfs check()'s
+            # prewarm note for the span gate and the peak-memory
+            # sequencing)
+            if obs.spans is not None:
+                with obs.span("compile"):
+                    self._prewarm_perlevel()
+
         if resume_from is not None:
             (carry, res, frontier_blocks, frontier_keys, n_states,
              n_vis, depth) = self._load_spill_checkpoint(resume_from)
+            prewarm()        # beside the loaded carry (resume-only)
             root_blk = None
         else:
             self._init_store()
@@ -814,6 +844,9 @@ class SpillEngine(Engine):
                               generated_states=n_roots, depth=0)
             self._check_pin_interiors(pin_interiors, res)
 
+            # warm BEFORE the real carry allocates (the dummy is
+            # donated away, so peak device memory stays ONE carry)
+            prewarm()
             carry = self._fresh_spill_carry()
             slots = self._host_probe_assign(rk, vcap=self.VCAP)
             sl = jnp.asarray(slots)
@@ -836,6 +869,8 @@ class SpillEngine(Engine):
             n_vis = n_roots
             depth = 0
             frontier_blocks = []
+
+        self._stamp_mode(res)
 
         def harvest_block(blk, keep=None):
             """Counts, violations, archives, next-frontier rows for one

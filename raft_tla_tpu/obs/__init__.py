@@ -33,17 +33,17 @@ from typing import Dict, Optional
 from .heartbeat import Heartbeat
 from .ledger import RunLedger, device_memory_stats, rss_bytes
 from .metrics import (BURST_COUNTER_KEYS, CHECK_COUNTER_KEYS,
-                      SIM_COUNTER_KEYS, SIM_DISPATCH_KEYS,
-                      MetricsRegistry, check_stats, sim_counters,
-                      sim_stats)
+                      MXU_COUNTER_KEYS, SIM_COUNTER_KEYS,
+                      SIM_DISPATCH_KEYS, MetricsRegistry, check_stats,
+                      sim_counters, sim_stats)
 from .spans import SpanRecorder
 
 __all__ = [
     "Obs", "NULL_OBS", "from_flags", "SpanRecorder", "RunLedger",
     "Heartbeat", "MetricsRegistry", "check_stats", "sim_stats",
     "sim_counters", "rss_bytes", "device_memory_stats",
-    "CHECK_COUNTER_KEYS", "BURST_COUNTER_KEYS", "SIM_COUNTER_KEYS",
-    "SIM_DISPATCH_KEYS",
+    "CHECK_COUNTER_KEYS", "BURST_COUNTER_KEYS", "MXU_COUNTER_KEYS",
+    "SIM_COUNTER_KEYS", "SIM_DISPATCH_KEYS",
 ]
 
 _NULL_CTX = contextlib.nullcontext()

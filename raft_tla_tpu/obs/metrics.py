@@ -30,7 +30,14 @@ from typing import Dict, Mapping, Optional
 CHECK_COUNTER_KEYS = (
     "distinct_states", "generated_states", "depth", "overflow_faults",
     "violations_global", "levels_fused", "burst_dispatches",
-    "burst_bailouts", "pin_interior_states")
+    "burst_bailouts", "pin_interior_states", "guard_matmul",
+    "dedup_kernel")
+
+# the MXU-path mode flags (0/1): which expansion/dedup program this
+# run executed — BENCH round 9 reads these next to the guard_matmul /
+# dedup_kernel span totals so the A/B attributes per phase AND records
+# which mode produced each row
+MXU_COUNTER_KEYS = ("guard_matmul", "dedup_kernel")
 
 # the burst telemetry triple that must agree between the ledger,
 # --stats-json and checkpoint meta (the PR-5 drift class)
@@ -137,6 +144,10 @@ def check_stats(counters: Mapping, seconds: float, n_violations: int,
         # level (burst_bailouts ~ depth with levels_fused 0)
         for k in BURST_COUNTER_KEYS:
             out[k] = int(counters[k])
+        # MXU-path mode flags (guard-matmul expansion / Pallas dedup
+        # kernel) — .get: pre-round-9 counter dicts lack them
+        for k in MXU_COUNTER_KEYS:
+            out[k] = int(counters.get(k, 0) or 0)
     return out
 
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..config import (CANDIDATE, CONFIG_ENTRY, FOLLOWER, LEADER, MT_AEREQ,
@@ -741,3 +742,126 @@ class RaftKernels:
                           jnp.where(consume, 1, 0)).astype(jnp.int32)
         sv_final["ctr"] = sv_final["ctr"].at[C_GLOBLEN].add(n_rec)
         return ok, sv_final
+
+    # ------------------------------------------------------------------
+    # Guard-only twins (the MXU guard-matrix path, engine/expand).
+    #
+    # The packed guard matrix reduces every lane's enabling guard to a
+    # thresholded int8 dot product against a per-state FEATURE vector;
+    # the message-slot families' guards are data-dependent per slot, so
+    # they ARE the features — computed here once per (state, slot)
+    # instead of once per (state, lane) by the vmapped kernel sweep.
+    # Each guard_* below must stay in lockstep with its kernel twin's
+    # ``ok`` (update_term / coc_discard / receive_main above); the
+    # matmul≡lane differential tests (tests/test_guard_matmul.py) and
+    # every engine's oracle differential pin the equivalence.
+    # ------------------------------------------------------------------
+
+    def guard_update_term(self, sv: State, k) -> jnp.ndarray:
+        """update_term's ``ok`` without the successor (header-only)."""
+        hs = self.lay.header_shifts
+        w0 = sv["bag"][k, 0]
+        i = get_field(w0, hs["mdst"]).astype(jnp.int32)
+        mterm = get_field(w0, hs["mterm"]).astype(jnp.int32)
+        return (sv["cnt"][k] > 0) & (mterm > sv["ct"][i])
+
+    def guard_coc_discard(self, sv: State, k) -> jnp.ndarray:
+        """coc_discard's ``ok`` without the successor (header-only)."""
+        hs = self.lay.header_shifts
+        w0 = sv["bag"][k, 0]
+        i = get_field(w0, hs["mdst"]).astype(jnp.int32)
+        mterm = get_field(w0, hs["mterm"]).astype(jnp.int32)
+        mtype = get_field(w0, hs["mtype"]).astype(jnp.int32)
+        return (sv["cnt"][k] > 0) & (mtype == MT_COC) & \
+            ((sv["st"][i] != LEADER) | (mterm == sv["ct"][i]))
+
+    def guard_receive(self, sv: State, k) -> jnp.ndarray:
+        """receive_main's ``ok`` without the successor construction:
+        exactly the guard sub-expressions of the main-handler lane (the
+        AEREQ branch family needs the log probe and ent[0], nothing
+        else — note rv_logok/rv_grant affect only the REPLY, not the
+        guard, so ``der`` is not needed here)."""
+        f = self.msg_fields(sv["bag"][k])
+        i, mterm, mtype = f["mdst"], f["mterm"], f["mtype"]
+        has = sv["cnt"][k] > 0
+        ct_i = sv["ct"][i]
+        st_i = sv["st"][i]
+        llen_i = sv["llen"][i]
+        log_i = sv["log"][i]
+        rvreq_ok = (mtype == MT_RVREQ) & (mterm <= ct_i)
+        rvresp_ok = (mtype == MT_RVRESP) & (mterm <= ct_i)
+        # AEREQ branch family (raft.tla:617-700): guard = any branch
+        prev_idx = f["a"]
+        ae_in_range = (prev_idx > 0) & (prev_idx <= llen_i)
+        ae_logok = (prev_idx == 0) | (
+            ae_in_range &
+            (f["b"] == self.entry_term(
+                log_i[jnp.clip(prev_idx - 1, 0, self.Lcap - 1)])))
+        eq = mterm == ct_i
+        ae_reject = (mterm < ct_i) | (eq & (st_i == FOLLOWER) & ~ae_logok)
+        ae_rtf = eq & (st_i == CANDIDATE)
+        ae_accept = eq & (st_i == FOLLOWER) & ae_logok
+        index = prev_idx + 1
+        have_at = llen_i >= index
+        term_match = self.entry_term(
+            log_i[jnp.clip(index - 1, 0, self.Lcap - 1)]) \
+            == self.entry_term(f["ent"][0])
+        ae_already = ae_accept & ((f["entlen"] == 0) |
+                                  (have_at & term_match))
+        ae_conflict = ae_accept & (f["entlen"] > 0) & have_at & ~term_match
+        ae_noconf = ae_accept & (f["entlen"] > 0) & (llen_i == prev_idx)
+        aereq_ok = (mtype == MT_AEREQ) & \
+            (ae_reject | ae_rtf | ae_already | ae_conflict | ae_noconf)
+        aeresp_ok = (mtype == MT_AERESP) & (mterm <= ct_i)
+        catreq_ok = mtype == MT_CATREQ
+        catresp_ok = mtype == MT_CATRESP
+        coc_ok = (mtype == MT_COC) & (st_i == LEADER) & (mterm == ct_i)
+        return has & (rvreq_ok | rvresp_ok | aereq_ok | aeresp_ok |
+                      catreq_ok | catresp_ok | coc_ok)
+
+    def guard_features(self, sv: State, der: State) -> jnp.ndarray:
+        """Per-state guard-feature vector φ(s), int8 [n_guard_features].
+
+        Every family's enabling guard is a signed-weight threshold over
+        these features (engine/expand builds the weight matrix), so the
+        whole [states × lanes] guard grid becomes ONE int8 matmul
+        φ @ W compared against the per-lane thresholds — exact by
+        construction (0/±1 weights, integer accumulation).  Layout is
+        ``guard_feature_offsets``; the two must move together."""
+        S = self.S
+        st = sv["st"]
+        leader = st == LEADER
+        cand = st == CANDIDATE
+        folc = (st == FOLLOWER) | cand
+        # BecomeLeader's quorum test, per server (vectorized in_quorum)
+        blq = self.in_quorum(sv["vg"], der["config"])
+        jj = jnp.arange(S)
+        cfgb = ((der["config"][:, None] >> jj[None, :]) & 1) == 1
+        nv = (((der["config"] & ~sv["vr"])[:, None]
+               >> jj[None, :]) & 1) == 1
+        ks = jnp.arange(self.K)
+        ut = jax.vmap(lambda k: self.guard_update_term(sv, k))(ks)
+        cocd = jax.vmap(lambda k: self.guard_coc_discard(sv, k))(ks)
+        recv = jax.vmap(lambda k: self.guard_receive(sv, k))(ks)
+        cnt1 = sv["cnt"] == 1
+        return jnp.concatenate([
+            leader, cand, folc, blq, cfgb.reshape(-1), nv.reshape(-1),
+            ut, cocd, recv, cnt1]).astype(jnp.int8)
+
+
+def guard_feature_offsets(lay: Layout) -> Dict[str, int]:
+    """Flat layout of ``RaftKernels.guard_features``: per-server role
+    blocks (leader / candidate / follower-or-candidate / become-leader
+    quorum), the two [S, S] config-bit grids (cfg[i,j], needvote[i,j],
+    row-major), then the four per-slot blocks (update_term /
+    coc_discard / receive / count==1).  The weight builder in
+    engine/expand indexes through THIS table only, so feature order has
+    a single definition."""
+    S, K = lay.S, lay.K
+    off = dict(leader=0, cand=S, folc=2 * S, blq=3 * S, cfg=4 * S,
+               needvote=4 * S + S * S)
+    base = 4 * S + 2 * S * S
+    off.update(ut=base, cocd=base + K, recv=base + 2 * K,
+               cnt1=base + 3 * K)
+    off["total"] = base + 4 * K
+    return off

@@ -116,7 +116,10 @@ class ShardedEngine(Engine):
                  lcap: int = 1 << 14, vcap: int = 1 << 17,
                  fcap: Optional[int] = None, scap: Optional[int] = None,
                  burst: bool = True,
-                 burst_levels: Optional[int] = None):
+                 burst_levels: Optional[int] = None,
+                 guard_matmul: bool = True,
+                 dedup_kernel: str = "auto",
+                 fam_density=None):
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(np.array(devices), axis_names=("d",))
         self.D = len(devices)
@@ -125,7 +128,10 @@ class ShardedEngine(Engine):
         self.BL = chunk // self.D              # frontier rows per device
         super().__init__(cfg, chunk=chunk, store_states=store_states,
                          lcap=lcap, vcap=vcap, fcap=fcap, burst=burst,
-                         burst_levels=burst_levels)
+                         burst_levels=burst_levels,
+                         guard_matmul=guard_matmul,
+                         dedup_kernel=dedup_kernel,
+                         fam_density=fam_density)
         # the sharded step computes full per-candidate fingerprints: the
         # incremental per-action path (engine/fingerprint) is not wired
         # into _local_step yet, so make the inherited flag's inertness
@@ -144,7 +150,8 @@ class ShardedEngine(Engine):
         self.LB = self._round_lb(max(lcap // self.D, 4 * self.FC,
                                      2 * self.D * self.SC))
         # per-family materialization caps are per-DEVICE (chunk/D rows)
-        self.FAM_CAPS = tuple(self.expander.default_fam_caps(self.BL))
+        self.FAM_CAPS = tuple(self.expander.default_fam_caps(
+            self.BL, self.fam_density))
         # step-atomic trip discipline: off here (whole-level journal
         # replay); the spill-composed subclass turns it on
         self._step_atomic = False
@@ -884,6 +891,7 @@ class ShardedEngine(Engine):
             n_vis = np.zeros((D,), np.int64)
             depth = 0
             resumed = False
+        self._stamp_mode(res)
 
         def run_finalize(carry):
             # seed carries have n_front=0 everywhere, so the level
@@ -1285,7 +1293,9 @@ class ShardedEngine(Engine):
                         for _ in range(self.W))
             ncl = jnp.full((new_vb,), U32MAX)
             ranks = jnp.arange(old_vb, dtype=jnp.uint32)
-            new, ncl, _f, _p, hv = self._probe_insert(
+            # lax path unconditionally: a rehash probes a whole table
+            # shard at once, not the per-candidate hot loop
+            new, ncl, _f, _p, hv = self._probe_insert_lax(
                 new, ncl, t, ~allones, ranks)
             # replicated so every controller can read it (multi-host)
             hv_all = jax.lax.all_gather(hv, "d").any()

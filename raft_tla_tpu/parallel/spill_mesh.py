@@ -311,6 +311,7 @@ class SpilledShardedEngine(ShardedEngine):
         roots, rk, pin_interiors = self._dedup_roots(seed_states)
         res = CheckResult(distinct_states=0, generated_states=len(rk),
                           depth=0)
+        self._stamp_mode(res)
         self._check_pin_interiors(pin_interiors, res)
         per_dev: List[List[int]] = [[] for _ in range(D)]
         for r in range(len(rk)):

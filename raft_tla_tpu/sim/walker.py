@@ -181,7 +181,8 @@ class SimEngine:
                  max_depth: int = 48, seed: int = 0,
                  policy: str = "punctuated",
                  traj_cap: Optional[int] = None,
-                 bloom_bits: int = 22, wid_base: int = 0):
+                 bloom_bits: int = 22, wid_base: int = 0,
+                 guard_matmul: bool = True):
         enable_persistent_compilation_cache()
         if policy not in ("punctuated", "tlc"):
             raise ValueError(f"unknown restart policy {policy!r}")
@@ -195,7 +196,14 @@ class SimEngine:
         self.wid_base = int(wid_base)
         self.lay = Layout(cfg)
         self.kern = RaftKernels(self.lay)
-        self.expander = Expander(cfg)
+        # the sim engine reuses select_enabled over the SAME guard grid
+        # the exhaustive engines dispatch on, so the MXU guard-matrix
+        # path (engine/expand docstring) drops in here unchanged:
+        # guards_T becomes the int8 matmul, step_lanes' per-walker
+        # param selection the one-hot einsum — trajectories are
+        # bit-identical either way (tests/test_guard_matmul.py)
+        self.guard_matmul = bool(guard_matmul)
+        self.expander = Expander(cfg, guard_matmul=self.guard_matmul)
         fp_cfg = cfg
         self.bloom_canonical = True
         if cfg.symmetry:

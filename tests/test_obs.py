@@ -76,7 +76,7 @@ def test_check_stats_keys_byte_compatible():
         "distinct_states", "generated_states", "depth", "seconds",
         "states_per_sec", "dedup_hit_rate", "violations", "fp_bits",
         "expected_fp_collisions", "levels_fused", "burst_dispatches",
-        "burst_bailouts")
+        "burst_bailouts", "guard_matmul", "dedup_kernel")
     # oracle payload (no engine telemetry)
     out = check_stats(r.metrics.as_dict(), 1.5, 2)
     assert tuple(out.keys()) == (
@@ -264,6 +264,41 @@ def test_telemetry_parity_all_engines(tmp_path):
     # and (belt + suspenders) identical counts — same config, same
     # space, four engines
     assert len(set(counts.values())) == 1, counts
+
+
+def test_burst_bailout_reuses_warmed_per_level_executable():
+    """The BENCH_r08 recompile leak (round-9 satellite): in burst mode
+    the per-level path runs only when a burst BAILS, and its cold
+    compile used to land mid-run inside a level_dispatch span (11.6 s
+    over 9 dispatches vs 1.65 s over 30 in per-level mode).  Pin the
+    fix: the per-level executables warm at run start inside ONE
+    compile span per mode, and the post-bail dispatches reuse the
+    warmed executable — the step jit compiles exactly once (the
+    density override maxes every family cap so no growth retrace can
+    blur the count)."""
+    from raft_tla_tpu.engine.bfs import Engine
+    from raft_tla_tpu.engine.expand import _FAMILY_DENSITY
+    dens = {nm: 1 << 10 for nm in _FAMILY_DENSITY}
+    for mode, burst in (("burst", True), ("per_level", False)):
+        rec = SpanRecorder()
+        obs = Obs(spans=rec)
+        # chunk=16 -> burst ring of 64 states: TINY's mid-run levels
+        # outgrow it, so bursts engage on the tiny levels AND bail
+        # mid-run, exercising the post-bail per-level path
+        eng = Engine(TINY, chunk=16, store_states=False, burst=burst,
+                     fam_density=dens)
+        r = eng.check(obs=obs)
+        tot = rec.totals()
+        assert tot["compile"]["count"] == 1, (mode, tot)
+        assert eng._step_jit._cache_size() == 1, mode
+        assert eng._fin_jit._cache_size() == 1, mode
+        if burst:
+            # the leak path actually engaged: bursts committed levels,
+            # bailed, and the per-level driver ran dispatches after
+            assert r.levels_fused > 0
+            assert r.burst_bailouts >= 1
+            assert tot["level_dispatch"]["count"] >= 1
+            assert r.depth - r.levels_fused >= 1
 
 
 def test_telemetry_parity_sim_engine(tmp_path):

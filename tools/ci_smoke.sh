@@ -14,3 +14,4 @@ cd "$(dirname "$0")/.."
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m smoke \
     -p no:cacheprovider "$@"
 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+env JAX_PLATFORMS=cpu python tools/guard_matmul_smoke.py

@@ -70,7 +70,8 @@ def main():
     conf_no = int(args.pop(0))
     depth = int(args.pop(0))
     flags = {f: f in args for f in ("--fp128", "--classic", "--native",
-                                    "--host-table", "--no-burst")}
+                                    "--host-table", "--no-burst",
+                                    "--no-guard-matmul")}
     for f, on in flags.items():
         if on:
             args.remove(f)
@@ -84,7 +85,7 @@ def main():
              "--fcap", "--ckpt", "--resume", "--ckpt-every",
              "--partitions", "--part-cap", "--burst-levels",
              "--ledger", "--heartbeat", "--trace-timeline",
-             "--profile-dir"}
+             "--profile-dir", "--dedup-kernel", "--fam-cap-density"}
     bad = set(opts) - known
     if bad or len(args) % 2:
         # fail loud: these depths cannot be cross-checked by any other
@@ -106,6 +107,20 @@ def main():
     budget = int(opts.get("--budget", 10 ** 9))
     partitions = int(opts.get("--partitions", 4))
     part_cap = int(opts.get("--part-cap", 1 << 16))
+    guard_matmul = not flags["--no-guard-matmul"]
+    dedup_kernel = opts.get("--dedup-kernel", "auto")
+    if dedup_kernel not in ("auto", "on", "off"):
+        raise SystemExit(f"--dedup-kernel must be auto|on|off "
+                         f"(got {dedup_kernel})")
+    fam_density = None
+    if "--fam-cap-density" in opts:
+        from raft_tla_tpu.engine.expand import parse_fam_density
+        try:
+            fam_density = parse_fam_density(opts["--fam-cap-density"])
+        except ValueError as e:
+            raise SystemExit(f"--fam-cap-density: {e}") from None
+    mxu_kw = dict(guard_matmul=guard_matmul, dedup_kernel=dedup_kernel,
+                  fam_density=fam_density)
     tag = opts.get("--tag",
                    f"config{conf_no}_depth{depth}"
                    + ("_fp128" if fp128 else "")
@@ -131,12 +146,13 @@ def main():
                      lcap=int(opts.get("--lcap", 1 << 21)),
                      fcap=int(opts["--fcap"]) if "--fcap" in opts
                      else None,
-                     burst=burst, burst_levels=burst_levels)
+                     burst=burst, burst_levels=burst_levels, **mxu_kw)
     else:
         eng = SpillEngine(cfg, chunk=chunk, store_states=False, seg=seg,
                           vcap=vcap, host_table=host_table,
                           partitions=partitions, part_cap=part_cap,
-                          burst=burst, burst_levels=burst_levels)
+                          burst=burst, burst_levels=burst_levels,
+                          **mxu_kw)
     from raft_tla_tpu.obs import from_flags
     obs = from_flags(ledger=opts.get("--ledger"),
                      heartbeat=opts.get("--heartbeat"),
@@ -196,6 +212,10 @@ def main():
         "levels_fused": int(r.levels_fused),
         "burst_dispatches": int(r.burst_dispatches),
         "burst_bailouts": int(r.burst_bailouts),
+        # MXU-path mode flags (round 9): which expansion/dedup program
+        # produced this row
+        "guard_matmul": int(r.guard_matmul),
+        "dedup_kernel": int(r.dedup_kernel),
         "resumed_from_checkpoint": bool(resume),
         "expected_fp_collisions": float(
             r.distinct_states ** 2 /

@@ -1,0 +1,66 @@
+"""Guard-matmul CI smoke (tools/ci_smoke.sh step, round 9).
+
+Two tiny CLI checks over the repo-local small config — the default
+``--guard-matmul`` (MXU path: guard grid as int8 matmul + one-hot
+successor einsum) and ``--no-guard-matmul`` (the historical vmapped
+lane sweep) — must land on IDENTICAL counts: distinct, generated,
+depth, dedup rate.  Exercises the end-to-end flag wiring (CLI →
+engine → Expander) plus the stats mode flags (guard_matmul 1/0).
+
+Depth-capped so the pair stays sub-minute on CPU; the full-space
+duplicates live in tests/test_guard_matmul.py.  Exits 0 on identity,
+1 with a message on any divergence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fail(msg):
+    print(f"guard_matmul_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_one(flag, stats_path):
+    cmd = [
+        sys.executable, "-m", "raft_tla_tpu", "check",
+        os.path.join(_REPO, "configs", "tlc_membership", "raft.cfg"),
+        "--servers", "2", "--init-servers", "2",
+        "--max-log-length", "1", "--max-timeouts", "1",
+        "--max-client-requests", "1", "--max-depth", "6",
+        flag, "--stats-json", stats_path,
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, env=env, cwd=_REPO,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        fail(f"check {flag} failed rc={proc.returncode}:\n"
+             f"{proc.stderr}")
+    return json.load(open(stats_path))
+
+
+def main():
+    td = tempfile.mkdtemp(prefix="guard_matmul_smoke_")
+    on = run_one("--guard-matmul", os.path.join(td, "on.json"))
+    off = run_one("--no-guard-matmul", os.path.join(td, "off.json"))
+    if on.get("guard_matmul") != 1 or off.get("guard_matmul") != 0:
+        fail(f"mode flags wrong: on={on.get('guard_matmul')} "
+             f"off={off.get('guard_matmul')} — the CLI flag did not "
+             "reach the engine")
+    for key in ("distinct_states", "generated_states", "depth",
+                "dedup_hit_rate", "violations"):
+        if on[key] != off[key]:
+            fail(f"{key}: guard-matmul {on[key]} != lane path "
+                 f"{off[key]} — the MXU path diverged")
+    print(f"guard_matmul_smoke: ok — ON ≡ OFF at depth {on['depth']} "
+          f"({on['distinct_states']} states) ({td})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

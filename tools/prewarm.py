@@ -30,17 +30,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def warm(tag, cfg, **kw):
-    """A depth-2 check per burst mode: the default (burst=True) pass
-    compiles the fused multi-level executable the tiny levels run on;
-    the burst=False pass compiles the per-level step/finalize pair the
-    engine falls back to the moment a level outgrows the burst ring —
-    BOTH are hit by every real run, so both land in the persistent
-    cache here."""
+    """A depth-2 check per (burst, guard-matmul) mode: the default
+    (burst=True) pass compiles the fused multi-level executable the
+    tiny levels run on; the burst=False pass compiles the per-level
+    step/finalize pair the engine falls back to the moment a level
+    outgrows the burst ring — BOTH are hit by every real run, so both
+    land in the persistent cache here.  Round 9: each burst mode warms
+    under BOTH matmul modes (the default MXU guard-matmul path and the
+    --no-guard-matmul lane sweep), so an A/B session pays no cold
+    compiles either way."""
     from raft_tla_tpu.engine.bfs import Engine
     t0 = time.time()
-    for burst in (True, False):
-        eng = Engine(cfg, store_states=False, burst=burst, **kw)
-        eng.check(max_depth=2)
+    for gm in (True, False):
+        for burst in (True, False):
+            eng = Engine(cfg, store_states=False, burst=burst,
+                         guard_matmul=gm, **kw)
+            eng.check(max_depth=2)
     print(f"{tag}: warmed in {time.time() - t0:.1f}s "
           f"(chunk={eng.chunk} LCAP={eng.LCAP} VCAP={eng.VCAP} "
           f"FCAP={eng.FCAP})", flush=True)
@@ -59,9 +64,11 @@ def warm_spill(tag, cfg, **kw):
     from raft_tla_tpu.engine.spill import SpillEngine
     t0 = time.time()
     modes = (True, False) if not kw.get("host_table") else (False,)
-    for burst in modes:
-        eng = SpillEngine(cfg, store_states=False, burst=burst, **kw)
-        eng.check(max_depth=2)
+    for gm in (True, False):           # both matmul modes (round 9)
+        for burst in modes:
+            eng = SpillEngine(cfg, store_states=False, burst=burst,
+                              guard_matmul=gm, **kw)
+            eng.check(max_depth=2)
     print(f"{tag}: warmed in {time.time() - t0:.1f}s "
           f"(chunk={eng.chunk} SEGL={eng.SEGL} VCAP={eng.VCAP} "
           f"host_table={eng.host_table})", flush=True)
