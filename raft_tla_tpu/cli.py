@@ -11,6 +11,12 @@ Mirrors the two ways the reference drives TLC (SURVEY §3.1, §3.5):
 
 Engine selection: --engine tpu (default; the JAX BFS) or --engine oracle
 (the plain-Python reference implementation, for cross-checking).
+
+Spec selection: --spec raft (default; the cfg positional is a TLC .cfg
+path) or --spec paxos (the cfg positional is optional — omitted or
+"default" builds the stock small PaxosConfig, else a JSON file of
+constants).  Every engine/oracle path below routes through the
+``SpecIR`` handle, so the two specs share the whole command surface.
 """
 
 from __future__ import annotations
@@ -112,6 +118,108 @@ def _apply_overrides(cfg, args):
     return cfg.with_(**kw) if kw else cfg
 
 
+def _load_paxos_model(args):
+    """--spec paxos config assembly: the cfg positional is optional
+    (None/"default" -> the stock small model; else a JSON file of
+    constants), then the generic CLI overrides apply (--servers =
+    acceptors, --ballots/--paxos-values/--instances, --symmetry,
+    --fp128, --invariant)."""
+    import json as _json
+    from .spec import get_spec
+    from .spec.paxos.config import PaxosConfig
+    raft_only = [flag for flag, attr in (
+        ("--next", "next_family"), ("--max-terms", "max_terms"),
+        ("--max-log-length", "max_log_length"),
+        ("--max-timeouts", "max_timeouts"),
+        ("--max-client-requests", "max_client_requests"),
+        ("--max-restarts", "max_restarts"),
+        ("--init-servers", "init_servers"))
+        if getattr(args, attr, None) is not None]
+    if raft_only:
+        raise SystemExit(
+            f"{', '.join(raft_only)} are raft-only bounds/toggles — "
+            "spec 'paxos' is bounded by --ballots/--paxos-values/"
+            "--instances/--servers instead")
+    kw = {}
+    if args.cfg and args.cfg != "default":
+        with open(args.cfg) as fh:
+            raw = _json.load(fh)
+        alias = {"acceptors": "n_servers", "servers": "n_servers",
+                 "ballots": "n_ballots", "values": "n_values",
+                 "instances": "n_instances"}
+        for k, v in raw.items():
+            kk = alias.get(k, k)
+            if kk not in ("n_servers", "n_ballots", "n_values",
+                          "n_instances", "symmetry", "fp128",
+                          "invariants"):
+                raise SystemExit(
+                    f"{args.cfg}: unknown paxos config key {k!r}")
+            if kk in ("symmetry", "fp128"):
+                if not isinstance(v, bool):
+                    raise SystemExit(
+                        f"{args.cfg}: {k} must be a JSON bool "
+                        f"(got {v!r})")
+            elif kk == "invariants":
+                known = get_spec("paxos").known_invariants
+                bad = [nm for nm in v if nm not in known]
+                if bad:
+                    raise SystemExit(
+                        f"{args.cfg}: unknown invariant(s) "
+                        f"{', '.join(map(repr, bad))} for spec "
+                        f"'paxos'; known: {', '.join(sorted(known))}")
+                v = tuple(v)
+            elif isinstance(v, bool) or not isinstance(v, int):
+                raise SystemExit(
+                    f"{args.cfg}: {k} must be a JSON integer "
+                    f"(got {v!r})")
+            kw[kk] = v
+    if args.servers is not None:
+        kw["n_servers"] = args.servers
+    if getattr(args, "ballots", None) is not None:
+        kw["n_ballots"] = args.ballots
+    if getattr(args, "paxos_values", None) is not None:
+        kw["n_values"] = args.paxos_values
+    if getattr(args, "instances", None) is not None:
+        kw["n_instances"] = args.instances
+    if args.symmetry is not None:
+        kw["symmetry"] = args.symmetry
+    if args.fp128:
+        kw["fp128"] = True
+    try:
+        cfg = PaxosConfig(**kw)
+    except ValueError as e:
+        raise SystemExit(f"paxos config: {e}")
+    if getattr(args, "invariants", None):
+        ir = get_spec("paxos")
+        for nm in args.invariants:
+            if nm not in ir.known_invariants:
+                raise SystemExit(
+                    f"unknown invariant {nm!r} for spec 'paxos'; "
+                    f"known: {', '.join(sorted(ir.known_invariants))}")
+        cfg = cfg.with_(invariants=tuple(dict.fromkeys(
+            cfg.invariants + tuple(args.invariants))))
+    if getattr(args, "constraint_overrides", None) or \
+            getattr(args, "action_constraints", None):
+        raise SystemExit(
+            "spec 'paxos' declares no constraints / action "
+            "constraints (the bounded space is finite without them)")
+    return cfg
+
+
+def _load_cfg(args):
+    """(SpecIR handle, model config) for the selected --spec."""
+    from .spec import get_spec
+    ir = get_spec(args.spec)
+    if args.spec == "paxos":
+        return ir, _load_paxos_model(args)
+    if not args.cfg:
+        raise SystemExit(
+            "a TLC .cfg path is required for --spec raft "
+            "(only --spec paxos has a built-in default model)")
+    cfg = load_model(args.cfg, bounds=None)
+    return ir, _apply_overrides(cfg, args)
+
+
 def _print_violation(idx, name, trace):
     print(f"\nViolation {idx}: invariant {name}")
     if trace:
@@ -120,33 +228,39 @@ def _print_violation(idx, name, trace):
             print(f"       {sv}")
 
 
-def _load_seeds(path):
-    """Seed-trace file -> list of seeds (punctuated search: BFS explores
-    only extensions of the pinned prefix, raft.tla:1198-1234).  Entries
-    carry the oracle state/hist plus the exact non-VIEW lanes when
+def _load_seeds(path, ir):
+    """Seed-trace file -> list of seeds (punctuated search: BFS
+    explores only extensions of the pinned prefix).  Entries carry the
+    active spec's oracle state/hist plus the exact non-VIEW lanes when
     emitted by the engine."""
     import json as _json
-    from .models.raft import state_from_obj
     with open(path) as fh:
         data = _json.load(fh)
     if isinstance(data, dict):
         data = [data]
     oracle_seeds, engine_seeds = [], []
     for obj in data:
-        sv, h = state_from_obj(obj)
+        # seed files are spec-tagged (paxos state_to_obj writes a
+        # "paxos" marker; untagged files are raft-era) — refuse a
+        # cross-spec seed with the same clarity as checkpoint resume
+        got_spec = "paxos" if obj.get("paxos") else "raft"
+        if got_spec != ir.name:
+            raise SystemExit(
+                f"{path}: seed was emitted for spec {got_spec!r}; "
+                f"this run is --spec {ir.name} — re-emit the seed "
+                f"with the matching --spec")
+        sv, h = ir.state_from_obj(obj)
         oracle_seeds.append((sv, h))
         engine_seeds.append((sv, h, obj.get("nonview")))
     return oracle_seeds, engine_seeds
 
 
-def _engine_seed_arrays(cfg, engine_seeds):
+def _engine_seed_arrays(cfg, ir, engine_seeds):
     import numpy as np
-    from .ops.codec import encode
-    from .ops.layout import Layout
-    lay = Layout(cfg)
+    lay = ir.make_layout(cfg)
     out = []
     for sv, h, nonview in engine_seeds:
-        arrs = encode(lay, sv, h)
+        arrs = ir.encode(lay, sv, h)
         if nonview:
             for k, v in nonview.items():
                 arrs[k] = np.asarray(v, dtype=arrs[k].dtype)
@@ -163,14 +277,18 @@ def _obs_flags_set(args) -> bool:
     return any(getattr(args, nm, None) for nm in _OBS_ARGS)
 
 
-def _build_obs(args):
+def _build_obs(args, ir=None):
     """The observability bundle the flags describe (obs package);
-    NULL_OBS when no flag is set."""
+    NULL_OBS when no flag is set.  ``ir`` stamps the active spec name
+    + IR fingerprint into every ledger record."""
     from .obs import from_flags
+    meta = ({"spec": ir.name, "ir_fingerprint": ir.fingerprint()}
+            if ir is not None else None)
     return from_flags(ledger=getattr(args, "ledger", None),
                       heartbeat=getattr(args, "heartbeat", None),
                       timeline=getattr(args, "trace_timeline", None),
-                      profile_dir=getattr(args, "profile_dir", None))
+                      profile_dir=getattr(args, "profile_dir", None),
+                      meta=meta)
 
 
 def _add_obs_flags(sp):
@@ -201,8 +319,7 @@ def _add_obs_flags(sp):
 
 
 def cmd_check(args):
-    cfg = load_model(args.cfg, bounds=None)
-    cfg = _apply_overrides(cfg, args)
+    ir, cfg = _load_cfg(args)
     if args.engine == "oracle" and (args.resume or args.checkpoint):
         print("--checkpoint/--resume are tpu-engine features",
               file=sys.stderr)
@@ -213,13 +330,12 @@ def cmd_check(args):
         return 2
     oracle_seeds = engine_seeds = None
     if args.seed_trace:
-        oracle_seeds, raw = _load_seeds(args.seed_trace)
+        oracle_seeds, raw = _load_seeds(args.seed_trace, ir)
         if args.engine == "oracle":
             # engine-emitted seeds (nonview lanes, no glob records)
             # cannot feed the oracle's record-scanning predicates: they
             # would silently evaluate against an empty history.
-            from .models.predicates import GLOB_DEPENDENT
-            needs_glob = GLOB_DEPENDENT & (
+            needs_glob = ir.glob_dependent & (
                 set(cfg.invariants) | set(cfg.constraints) |
                 set(cfg.action_constraints))
             for _sv, h, nonview in raw:
@@ -231,9 +347,9 @@ def cmd_check(args):
                           f"--emit-seed`", file=sys.stderr)
                     return 2
         else:
-            engine_seeds = _engine_seed_arrays(cfg, raw)
+            engine_seeds = _engine_seed_arrays(cfg, ir, raw)
     if args.engine == "oracle":
-        from .models.explore import explore
+        explore = ir.oracle_explore
         import time
         if _obs_flags_set(args):
             # the oracle has no dispatches to ledger/heartbeat; say so
@@ -268,7 +384,8 @@ def cmd_check(args):
         if args.fam_cap_density:
             from .engine.expand import parse_fam_density
             try:
-                fam_density = parse_fam_density(args.fam_cap_density)
+                fam_density = parse_fam_density(args.fam_cap_density,
+                                                ir)
             except ValueError as e:
                 print(f"--fam-cap-density: {e}", file=sys.stderr)
                 return 2
@@ -297,7 +414,7 @@ def cmd_check(args):
                          store_states=not args.no_store,
                          archive_dir=args.archive_dir,
                          **burst_kw)
-        obs = _build_obs(args)
+        obs = _build_obs(args, ir)
         obs.start()
         done = False
         try:
@@ -362,10 +479,12 @@ def cmd_check(args):
             depth=int(depth),
             pin_interior_states=int(
                 getattr(r, "pin_interior_states", 0) or 0))
-        out = check_stats(counters, secs, len(viol))
+        out = check_stats(counters, secs, len(viol),
+                          spec=ir.name, ir_fp=ir.fingerprint())
     else:
         out = check_stats(r.metrics.as_dict(), secs, len(viol),
-                          fp_bits=128 if args.fp128 else 64)
+                          fp_bits=128 if args.fp128 else 64,
+                          spec=ir.name, ir_fp=ir.fingerprint())
     print(json.dumps(out))
     if args.stats_json:
         # oracle runs write the same stats file (minus the
@@ -396,49 +515,46 @@ def _write_seed(path, obj):
     print(f"seed written to {path}", file=sys.stderr)
 
 
-def _seed_obj(sv, hist, arrs):
+def _seed_obj(ir, sv, hist, arrs):
     """Witness end state -> the seed-file object `check --seed-trace`
-    accepts: oracle view (state_to_obj) plus the raw non-VIEW lanes
-    (exact history counters + scenario feature lanes), so a seeded
-    engine resumes with identical constraint / scenario-predicate
-    inputs.  ONE definition — trace and simulate both emit through it,
-    so their seed files cannot drift."""
+    accepts: the active spec's oracle view (state_to_obj) plus the raw
+    non-VIEW lanes, so a seeded engine resumes with identical
+    constraint / scenario-predicate inputs.  ONE definition — trace
+    and simulate both emit through it, so seed files cannot drift."""
     import numpy as np
-    from .models.raft import state_to_obj
-    from .ops.codec import NONVIEW_KEYS
-    obj = state_to_obj(sv, hist)
+    obj = ir.state_to_obj(sv, hist)
     obj["nonview"] = {k: np.asarray(arrs[k]).tolist()
-                      for k in NONVIEW_KEYS}
+                      for k in ir.nonview_keys}
     return obj
 
 
-def _check_target(name) -> bool:
-    """Validate a --target against the shared scenario registry
-    (ops/vpredicates.SCENARIO_PROPERTIES — the ONE table trace,
-    simulate and the help text all read, so new sim-reachable targets
-    cannot drift out of the CLI).  Safety invariants are also accepted
-    (hunting a real violation is a legitimate target)."""
-    from .models import predicates as OP
-    from .ops.vpredicates import SCENARIO_PROPERTIES
-    if name in OP.INVARIANTS:
+def _check_target(name, ir) -> bool:
+    """Validate a --target against the active spec's scenario registry
+    (SpecIR.scenario_properties — the ONE table trace, simulate and
+    the help text all read, so new sim-reachable targets cannot drift
+    out of the CLI).  Safety invariants are also accepted (hunting a
+    real violation is a legitimate target)."""
+    if name in ir.known_invariants:
         return True
-    print(f"unknown scenario property {name!r}; known scenario "
-          f"properties: {', '.join(SCENARIO_PROPERTIES)}\n"
+    others = sorted(set(ir.known_invariants) -
+                    set(ir.scenario_properties))
+    print(f"unknown scenario property {name!r} for spec "
+          f"{ir.name!r}; known scenario properties: "
+          f"{', '.join(ir.scenario_properties)}\n"
           f"(safety invariants are accepted too: "
-          f"{', '.join(sorted(set(OP.INVARIANTS) - set(SCENARIO_PROPERTIES)))})",
+          f"{', '.join(others)})",
           file=sys.stderr)
     return False
 
 
 def cmd_trace(args):
-    if not _check_target(args.target):
+    ir, cfg = _load_cfg(args)
+    if not _check_target(args.target, ir):
         return 2
-    cfg = load_model(args.cfg, bounds=None)
-    cfg = _apply_overrides(cfg, args)
     cfg = cfg.with_(invariants=(args.target,))
     if args.engine == "oracle":
         import time
-        from .models.explore import explore
+        explore = ir.oracle_explore
         t0 = time.perf_counter()
         r = explore(cfg, max_depth=args.max_depth,
                     max_states=args.max_states, stop_on_violation=True,
@@ -453,9 +569,9 @@ def cmd_trace(args):
         for step, label in enumerate(r.violations[0].trace):
             print(f"  {step + 1:3d}  {label}")
         if args.emit_seed:
-            from .models.raft import state_to_obj
             v = r.violations[0]
-            _write_seed(args.emit_seed, state_to_obj(v.state, v.hist))
+            _write_seed(args.emit_seed,
+                        ir.state_to_obj(v.state, v.hist))
         return 0
     from .engine.bfs import Engine
     eng = Engine(cfg, chunk=args.chunk, store_states=True,
@@ -475,10 +591,9 @@ def cmd_trace(args):
         if args.verbose:
             print(f"       {sv}")
     if args.emit_seed:
-        from .ops.codec import decode
         arrs = eng.get_state_arrays(v.state_id)
-        sv, h = decode(eng.lay, arrs)
-        _write_seed(args.emit_seed, _seed_obj(sv, h, arrs))
+        sv, h = ir.decode(eng.lay, arrs)
+        _write_seed(args.emit_seed, _seed_obj(ir, sv, h, arrs))
     return 0
 
 
@@ -488,8 +603,6 @@ def cmd_simulate(args):
     design notes).  Exit 0 on a witness, 1 on none within the step
     budget."""
     import time
-    if not _check_target(args.target):
-        return 2
     # a clear bounds error beats the jit-time shape traceback a
     # non-positive loop length would produce (ROADMAP sim follow-ups)
     for nm, val in (("--steps-per-dispatch", args.steps_per_dispatch),
@@ -499,8 +612,9 @@ def cmd_simulate(args):
             print(f"{nm} must be positive (got {val})",
                   file=sys.stderr)
             return 2
-    cfg = load_model(args.cfg, bounds=None)
-    cfg = _apply_overrides(cfg, args)
+    ir, cfg = _load_cfg(args)
+    if not _check_target(args.target, ir):
+        return 2
     cfg = cfg.with_(invariants=(args.target,))
     # --max-depth doubles as the walk restart bound; the check-style
     # "unbounded" default maps to a walk-sized one
@@ -515,7 +629,7 @@ def cmd_simulate(args):
         eng = ShardedSimEngine(cfg, walkers=args.walkers, **kw)
     else:
         eng = SimEngine(cfg, walkers=args.walkers, **kw)
-    obs = _build_obs(args)
+    obs = _build_obs(args, ir)
     obs.start()
     t0 = time.perf_counter()
     done = False
@@ -535,6 +649,10 @@ def cmd_simulate(args):
     from .obs.metrics import sim_stats
     out = sim_stats(r, target=args.target, policy=args.policy,
                     seed=args.seed, platform=jax.default_backend())
+    # the active SpecIR stamp, appended last (same contract as
+    # check_stats' spec/ir_fingerprint tail keys)
+    out["spec"] = ir.name
+    out["ir_fingerprint"] = ir.fingerprint()
     print(json.dumps(out))
     if args.stats_json:
         with open(args.stats_json, "w") as fh:
@@ -561,7 +679,8 @@ def cmd_simulate(args):
               file=sys.stderr)
     if args.emit_seed:
         _write_seed(args.emit_seed,
-                    _seed_obj(h.trace[-1][1], h.hist, h.state_arrs))
+                    _seed_obj(ir, h.trace[-1][1], h.hist,
+                              h.state_arrs))
     return 0
 
 
@@ -573,7 +692,19 @@ def main(argv=None):
     sub = p.add_subparsers(dest="cmd", required=True)
 
     def common(sp):
-        sp.add_argument("cfg", help="path to a TLC .cfg model file")
+        sp.add_argument("cfg", nargs="?", default=None,
+                        help="model file: a TLC .cfg path (--spec "
+                             "raft; required) or a JSON constants "
+                             "file / 'default' (--spec paxos; "
+                             "optional)")
+        sp.add_argument("--spec", choices=("raft", "paxos"),
+                        default="raft",
+                        help="which spec frontend (SpecIR) to check: "
+                             "the Raft membership-change spec "
+                             "(default) or bounded single-decree/"
+                             "multi-instance Paxos — same engines, "
+                             "same flags, same oracle-differential "
+                             "guarantees")
         sp.add_argument("--engine", choices=("tpu", "oracle"),
                         default="tpu")
         sp.add_argument("--chunk", type=int, default=512)
@@ -598,6 +729,14 @@ def main(argv=None):
         sp.add_argument("--max-client-requests", type=int, default=None)
         sp.add_argument("--max-restarts", type=int, default=None)
         sp.add_argument("--fp128", action="store_true")
+        # --spec paxos constants (ignored for raft)
+        sp.add_argument("--ballots", type=int, default=None,
+                        help="paxos: ballots 0..N-1 (--spec paxos)")
+        sp.add_argument("--paxos-values", type=int, default=None,
+                        help="paxos: values 0..N-1 (--spec paxos)")
+        sp.add_argument("--instances", type=int, default=None,
+                        help="paxos: independent consensus instances "
+                             "(--spec paxos)")
         sp.add_argument("--guard-matmul",
                         action=argparse.BooleanOptionalAction,
                         default=True,
@@ -712,12 +851,15 @@ def main(argv=None):
                     help="enable an extra ACTION_CONSTRAINT (repeatable)")
     pc.set_defaults(fn=cmd_check)
 
-    # --target help comes from the ONE scenario registry
-    # (ops/vpredicates.SCENARIO_PROPERTIES) so new sim-reachable
-    # targets cannot drift out of the help text
-    from .ops.vpredicates import SCENARIO_PROPERTIES
-    target_help = ("scenario property name: " +
-                   ", ".join(SCENARIO_PROPERTIES))
+    # --target help comes from the per-spec scenario registries
+    # (SpecIR.scenario_properties) so new sim-reachable targets cannot
+    # drift out of the help text
+    from .spec import get_spec
+    target_help = ("scenario property of the active --spec (raft: " +
+                   ", ".join(get_spec("raft").scenario_properties) +
+                   "; paxos: " +
+                   ", ".join(get_spec("paxos").scenario_properties) +
+                   ")")
 
     pt = sub.add_parser("trace", help="generate a scenario witness trace")
     common(pt)

@@ -133,6 +133,11 @@ class Bounds:
 class ModelConfig:
     """One checkable model: constants + NEXT + toggles (= one raft.cfg)."""
 
+    # SpecIR dispatch marker (spec/ package) — class attribute, NOT a
+    # dataclass field, so repr(cfg) (the checkpoint-compat key) is
+    # byte-identical to every pre-IR checkpoint's
+    spec = "raft"
+
     n_servers: int = 3                      # |Server|
     init_servers: Tuple[int, ...] = (0, 1, 2)   # InitServer ⊆ Server
     values: Tuple[int, ...] = (1, 2)        # Value

@@ -51,15 +51,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..config import CANDIDATE, ModelConfig
-from ..models.raft import Hist, State, init_state
+from ..config import ModelConfig
 from ..obs import NULL_OBS
 from ..obs.metrics import CHECK_COUNTER_KEYS
-from ..ops.codec import (C_GLOBLEN, C_OVERFLOW, decode, encode, narrow,
-                         widen)
-from ..ops.kernels import RaftKernels
-from ..ops.layout import Layout
-from ..ops.vpredicates import Predicates
+from ..ops.codec import C_OVERFLOW
+from ..spec import spec_of
 from ..utils import HOME_SALT
 from ..utils import cat_arrays as _cat
 from ..utils import fmix32_int as _fmix32_int
@@ -111,8 +107,10 @@ def enable_persistent_compilation_cache():
 class Violation:
     invariant: str
     state_id: int
-    state: Optional[State] = None
-    hist: Optional[Hist] = None
+    # the spec oracle's (state, hist) pair — raft State/Hist or the
+    # paxos twins, depending on the engine's SpecIR
+    state: Optional[object] = None
+    hist: Optional[object] = None
     trace: Optional[List[str]] = None
 
 
@@ -261,14 +259,19 @@ def ckpt_write(path, carry, store_states, parents, lanes, states, res,
 
 
 def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded, spill=False,
-              expected_format=None):
+              expected_format=None, spec_name=None):
     """np.load + the meta validation every engine shares.  Returns
     (npz, meta) or raises CheckpointError.
 
     expected_format — optional (meta_key, want_value, why) triple: the
     engine's checkpoint-format gate, checked here so every engine
     versions its files one way (meta lacking the key reads as format 1
-    — the pre-versioning era)."""
+    — the pre-versioning era).
+
+    spec_name — the resuming engine's SpecIR name: resume refuses on a
+    spec mismatch (same pattern as the config-mismatch refusal below;
+    meta lacking the key reads as "raft" — every pre-IR checkpoint is
+    a Raft one)."""
     import json
     try:
         z = np.load(path, allow_pickle=False)
@@ -279,6 +282,13 @@ def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded, spill=False,
         raise CheckpointError(f"{path}: not an engine checkpoint "
                               "(no meta record)")
     meta = json.loads(str(z["meta"]))
+    if spec_name is not None:
+        got_spec = meta.get("spec", "raft")
+        if got_spec != spec_name:
+            raise CheckpointError(
+                f"{path}: checkpoint was written for spec "
+                f"{got_spec!r}; engine is running spec {spec_name!r} "
+                f"— resume with --spec {got_spec}")
     # spill before sharded: a spill checkpoint handed to ShardedEngine
     # must name SpillEngine, not "the single-device Engine"
     if bool(meta.get("spill")) != spill:
@@ -398,6 +408,10 @@ class Engine:
                  fam_density: Optional[Dict[str, int]] = None):
         enable_persistent_compilation_cache()
         self.cfg = cfg
+        # the active spec's compiled operator surface (SpecIR): layout,
+        # codec, kernels, families, predicates, fingerprints, oracle —
+        # every model-specific hook below routes through this handle
+        self.ir = spec_of(cfg)
         # observability bundle (obs/): check() rebinds it per run; the
         # archive/checkpoint helpers read it so their spans land on the
         # active run's timeline
@@ -417,8 +431,8 @@ class Engine:
         # incremental per-action fingerprints (auto-off for big
         # symmetry groups — fingerprint.supports_incremental)
         self.incremental_fp = incremental_fp
-        self.lay = Layout(cfg)
-        self.kern = RaftKernels(self.lay)
+        self.lay = self.ir.make_layout(cfg)
+        self.kern = self.ir.make_kernels(self.lay)
         # MXU-native expansion (guard grid as int8 matmul + one-hot
         # einsum selection — expand.Expander docstring): default ON,
         # bit-exact by construction; guard_matmul=False restores the
@@ -442,7 +456,7 @@ class Engine:
             (dedup_kernel == "auto" and plat == "tpu"))
         self._dedup_interpret = plat != "tpu"
         self.fpr = Fingerprinter(cfg)
-        self.preds = Predicates(self.lay)
+        self.preds = self.ir.make_predicates(self.lay)
         self.inv_names = list(cfg.invariants)
         self.con_names = list(cfg.constraints)
         self.act_names = list(cfg.action_constraints)
@@ -525,16 +539,13 @@ class Engine:
     # ------------------------------------------------------------------
 
     def _act_ok(self, parent_sv, cand_sv):
-        """ACTION_CONSTRAINTS (raft.tla:1207-1210): evaluated on the
-        (unprimed, primed) pair; violating transitions are not taken."""
+        """ACTION_CONSTRAINTS (TLC semantics): evaluated on the
+        (unprimed, primed) pair; violating transitions are not taken.
+        The name registry is part of the spec surface
+        (preds.action_fn) — an unknown name errors naming the spec."""
         ok = jnp.bool_(True)
         for nm in self.act_names:
-            if nm == "CommitWhenConcurrentLeaders_action_constraint":
-                deep = parent_sv["ctr"][C_GLOBLEN] >= 20
-                no_cand = jnp.all(cand_sv["st"] != CANDIDATE)
-                ok = ok & (~deep | no_cand)
-            else:
-                raise KeyError(f"unknown action constraint {nm}")
+            ok = ok & self.preds.action_fn(nm)(parent_sv, cand_sv)
         return ok
 
     def _phase1_impl(self, svb):
@@ -862,7 +873,7 @@ class Engine:
         # K) are far smaller than the TPU's (8, 128) vector tiles, so
         # keeping them off the lane axis is worth ~5x on the successor
         # materialization (expand.Expander.materialize docstring).
-        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B,
+        sv = self.ir.widen({k: lax.dynamic_slice_in_dim(v, base, B,
                                                 axis=v.ndim - 1)
                     for k, v in carry["front"].items()})
         fmask = lax.dynamic_slice_in_dim(carry["fmask"], base, B)
@@ -931,7 +942,7 @@ class Engine:
         # invariants + constraints on the fresh rows (garbage rows are
         # masked by n_lvl at finalize)
         inv, con = lax.optimization_barrier(self._phase2_T(rows))
-        rows_n = narrow(self.lay, rows)        # storage dtypes for lvl
+        rows_n = self.ir.narrow(self.lay, rows)   # storage dtypes
         lvl = {k: lax.dynamic_update_slice_in_dim(
                    v, rows_n[k], start, v.ndim - 1)
                for k, v in carry["lvl"].items()}
@@ -1151,7 +1162,7 @@ class Engine:
 
         def body(st):
             base, nl = st["base"], st["nl"]
-            sv = widen({k: lax.dynamic_slice_in_dim(v, base, B,
+            sv = self.ir.widen({k: lax.dynamic_slice_in_dim(v, base, B,
                                                     axis=v.ndim - 1)
                         for k, v in st["fr"].items()})
             fm_c = lax.dynamic_slice_in_dim(st["fm"], base, B)
@@ -1205,7 +1216,7 @@ class Engine:
             rows = lax.optimization_barrier(
                 {k: cand_c[k][..., oidx] for k in cand_c})
             inv, con = self._phase2_T(rows)
-            rows_n = narrow(self.lay, rows)
+            rows_n = self.ir.narrow(self.lay, rows)
             # ring positions for the compacted rows: nl + row index
             oar = jnp.arange(OC, dtype=jnp.int32)
             rpos = jnp.where(oar < n_fresh, nl + oar, KB)
@@ -1335,7 +1346,8 @@ class Engine:
                      ocap: Optional[int] = None):
         fcap = fcap if fcap is not None else self.FCAP
         ocap = ocap if ocap is not None else self.OCAP
-        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
+        one = self.ir.narrow(self.lay, self.ir.encode(
+            self.lay, *self.ir.init_state(self.cfg)))
         # frontier/level state buffers are BATCH-LAST ([..., lcap]) —
         # see the chunk step's layout note
         zeros = {k: jnp.zeros(v.shape + (lcap,), dtype=v.dtype)
@@ -1432,15 +1444,17 @@ class Engine:
         canonical fingerprints, pin_interiors or None)."""
         pin_interiors = None
         if seed_states is None and self.cfg.prefix_pins:
-            from ..models.golden import prefix_pin_seeds
-            seed_states, pin_interiors = prefix_pin_seeds(
+            if self.ir.prefix_pin_seeds is None:
+                raise ValueError(
+                    f"spec {self.ir.name!r} has no prefix-pin support")
+            seed_states, pin_interiors = self.ir.prefix_pin_seeds(
                 self.cfg, with_interior=True)
         init_list = (seed_states if seed_states is not None
-                     else [init_state(self.cfg)])
-        init_arrs = widen(_cat([
+                     else [self.ir.init_state(self.cfg)])
+        init_arrs = self.ir.widen(_cat([
             {k: np.asarray(v)[None] for k, v in s.items()}
             if isinstance(s, dict) else
-            {k: v[None] for k, v in encode(self.lay, *s).items()}
+            {k: v[None] for k, v in self.ir.encode(self.lay, *s).items()}
             for s in init_list]))
         rootsb = {k: jnp.asarray(v) for k, v in init_arrs.items()}
         root_fp = np.asarray(self._rootfp_jit(rootsb)).astype(np.uint32)
@@ -1588,7 +1602,8 @@ class Engine:
             # buffer (~340 B/row x millions of rows at ~50 MB/s, tens
             # of seconds of "warm start" per check() call).
             roots_n = {k: np.moveaxis(v, 0, -1) for k, v in
-                       narrow(self.lay, widen(roots)).items()}
+                       self.ir.narrow(self.lay,
+                                      self.ir.widen(roots)).items()}
             carry["lvl"] = {
                 k: v.at[..., :n_roots].set(jnp.asarray(roots_n[k]))
                 for k, v in carry["lvl"].items()}
@@ -1656,7 +1671,8 @@ class Engine:
                         for k, v in carry["front"].items()}
                 for j, nm in enumerate(self.inv_names):
                     for s in np.nonzero(~inv_ok[j])[0]:
-                        vsv, vh = decode(self.lay, _take(rows, s))
+                        vsv, vh = self.ir.decode(self.lay,
+                                                 _take(rows, s))
                         res.violations.append(
                             Violation(nm, n_states + int(s),
                                       state=vsv, hist=vh))
@@ -1746,8 +1762,8 @@ class Engine:
                                 for j, nm in enumerate(self.inv_names):
                                     for s in np.nonzero(
                                             ~inv_h[j, li, :n_lvl])[0]:
-                                        vsv, vh = decode(self.lay,
-                                                         _take(rows, s))
+                                        vsv, vh = self.ir.decode(
+                                            self.lay, _take(rows, s))
                                         res.violations.append(Violation(
                                             nm, n_states + int(s),
                                             state=vsv, hist=vh))
@@ -1917,9 +1933,10 @@ class Engine:
         in CheckResult.pin_interior_states as the divergence bound."""
         if not interiors:
             return
-        arrs = widen(_cat([{k: v[None] for k, v in
-                            encode(self.lay, *s).items()}
-                           for s in interiors]))
+        arrs = self.ir.widen(_cat([
+            {k: v[None] for k, v in self.ir.encode(self.lay,
+                                                   *s).items()}
+            for s in interiors]))
         b = {k: jnp.asarray(v) for k, v in arrs.items()}
         keys = fp_key(np.asarray(self._rootfp_jit(b)))
         _uniq, first = np.unique(keys, return_index=True)
@@ -1951,6 +1968,8 @@ class Engine:
                            OCAP=self.OCAP,
                            fam_caps=list(self.FAM_CAPS), **arch_meta,
                            layout=2, chunk=self.chunk,
+                           spec=self.ir.name,
+                           ir_fingerprint=self.ir.fingerprint(),
                            cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
@@ -1959,7 +1978,8 @@ class Engine:
                              "fam_caps"),
                             sharded=False, expected_format=(
                                 "layout", 2, "this engine's batch-last/"
-                                "narrow-dtype storage layout"))
+                                "narrow-dtype storage layout"),
+                            spec_name=self.ir.name)
         self.LCAP, self.VCAP, self.FCAP, self.OCAP = (
             meta["LCAP"], meta["VCAP"], meta["FCAP"], meta["OCAP"])
         self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])
@@ -1977,8 +1997,8 @@ class Engine:
 
     # ------------------------------------------------------------------
 
-    def get_state(self, gid: int) -> Tuple[State, Hist]:
-        return decode(self.lay, self.get_state_arrays(gid))
+    def get_state(self, gid: int) -> Tuple:
+        return self.ir.decode(self.lay, self.get_state_arrays(gid))
 
     def get_state_arrays(self, gid: int) -> Dict[str, np.ndarray]:
         assert self.store_states, "state store disabled"
@@ -1992,7 +2012,7 @@ class Engine:
             off += n
         raise IndexError(gid)
 
-    def trace(self, gid: int) -> List[Tuple[str, State]]:
+    def trace(self, gid: int) -> List[Tuple]:
         if self._arch is not None:
             # memmap'd walk: each hop reads one parent/lane pair and
             # one state row — no level is ever loaded whole

@@ -1,30 +1,29 @@
 """Frontier expansion: the Next-relation as one vmapped/jitted step.
 
 The action grid mirrors the ∃-quantification TLC performs (SURVEY §3.1):
-each *family* (RequestVote, Receive, …) is vmapped over its parameter grid
-(server pairs, values, bag slots) and over the frontier batch axis, then
-families concatenate into a [B, A] candidate block with validity masks.
+each *family* (RequestVote, Phase2a, …) is vmapped over its parameter
+grid (server pairs, values, bag slots) and over the frontier batch
+axis, then families concatenate into a [B, A] candidate block with
+validity masks.
 
-Family order follows the oracle's successor enumeration
-(models/raft.py successors(), itself mirroring raft.tla:909-943) so
-candidate streams are comparable; receive lanes are family-major
-(UpdateTerm block, CheckOldConfig-discard block, main-handler block).
+SPEC-AGNOSTIC since round 10: the family registry, the guard-algebra
+declarations behind the int8 guard matmul, and the per-family density
+caps all come from the active ``SpecIR`` (``spec/`` — raft and paxos
+today).  Family order follows each spec's oracle successor enumeration
+so candidate streams are comparable; a family without a declared guard
+algebra fails loudly at construction, naming the spec.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import (NEXT_ASYNC_CRASH, NEXT_DYNAMIC, NEXT_FULL,
-                      ModelConfig)
-from ..ops.codec import ALL_KEYS
-from ..ops.kernels import RaftKernels
-from ..ops.layout import Layout
+from ..spec import spec_of
 
 
 @dataclass
@@ -33,104 +32,46 @@ class Family:
     fn: Callable            # (sv, der, *params) -> (ok, sv2)
     params: Tuple[np.ndarray, ...]   # one array per param, equal length
     labeler: Callable        # (*param_values) -> str
+    # guard-algebra declaration for the MXU guard-matrix path:
+    # (feature-offset table, layout, *lane params) ->
+    # ([(feature_index, weight)], threshold) over the spec kernels'
+    # guard_features vector.  Part of the SpecIR contract: a family
+    # without one fails at Expander construction (the int8 guard
+    # matmul cannot silently fall back without forking the two paths).
+    guard: Optional[Callable] = None
 
     @property
     def n_lanes(self):
         return len(self.params[0]) if self.params else 1
 
 
-def build_families(lay: Layout) -> List[Family]:
-    cfg = lay.cfg
-    kern = RaftKernels(lay)
-    S, K = lay.S, lay.K
-    fams: List[Family] = []
-
-    def grid(*ranges):
-        arrs = np.meshgrid(*[np.asarray(r, np.int32) for r in ranges],
-                           indexing="ij")
-        return tuple(a.ravel() for a in arrs)
-
-    ij = grid(range(S), range(S))
-    ij_ne = tuple(a[ij[0] != ij[1]] for a in ij)        # i != j lanes
-    iv = grid(range(S), list(cfg.values))
-    i_ = grid(range(S))
-    k_ = grid(range(K))
-
-    fams.append(Family(
-        "RequestVote", kern.request_vote, ij,
-        lambda i, j: f"RequestVote({i},{j})"))
-    fams.append(Family(
-        "BecomeLeader", kern.become_leader, i_,
-        lambda i: f"BecomeLeader({i})"))
-    fams.append(Family(
-        "ClientRequest", kern.client_request, iv,
-        lambda i, v: f"ClientRequest({i},{v})"))
-    fams.append(Family(
-        "AdvanceCommitIndex", kern.advance_commit_index, i_,
-        lambda i: f"AdvanceCommitIndex({i})"))
-    fams.append(Family(
-        "AppendEntries", kern.append_entries, ij_ne,
-        lambda i, j: f"AppendEntries({i},{j})"))
-    fams.append(Family(
-        "UpdateTerm", kern.update_term, k_,
-        lambda k: f"UpdateTerm[slot{k}]"))
-    fams.append(Family(
-        "CocDiscard", kern.coc_discard, k_,
-        lambda k: f"CocDiscard[slot{k}]"))
-    fams.append(Family(
-        "Receive", kern.receive_main, k_,
-        lambda k: f"Receive[slot{k}]"))
-    fams.append(Family(
-        "Timeout", kern.timeout, i_,
-        lambda i: f"Timeout({i})"))
-    if cfg.next_family in (NEXT_ASYNC_CRASH, NEXT_FULL, NEXT_DYNAMIC):
-        fams.append(Family(
-            "Restart", lambda sv, der, i: kern.restart(sv, i), i_,
-            lambda i: f"Restart({i})"))
-    if cfg.next_family in (NEXT_FULL, NEXT_DYNAMIC):
-        fams.append(Family(
-            "Duplicate", lambda sv, der, k: kern.duplicate_message(sv, k),
-            k_, lambda k: f"Duplicate[slot{k}]"))
-        fams.append(Family(
-            "Drop", lambda sv, der, k: kern.drop_message(sv, k),
-            k_, lambda k: f"Drop[slot{k}]"))
-    if cfg.next_family == NEXT_DYNAMIC:
-        fams.append(Family(
-            "AddNewServer", kern.add_new_server, ij,
-            lambda i, j: f"AddNewServer({i},{j})"))
-        fams.append(Family(
-            "DeleteServer", kern.delete_server, ij_ne,
-            lambda i, j: f"DeleteServer({i},{j})"))
-    return fams
+# Per-family enabled-lane density caps are part of the SpecIR contract
+# (cap_f = chunk * min(n_lanes_f, density); overflow trips fovf, the
+# engine grows the cap and replays the level — throughput tuning, not
+# correctness bounds).  Each spec owns its measured table
+# (spec/raft_ir.FAMILY_DENSITY, spec/paxos/ir.FAMILY_DENSITY); the
+# historical module-level name stays as the raft alias for existing
+# imports.
+from ..spec.raft_ir import FAMILY_DENSITY as _FAMILY_DENSITY  # noqa: E402
 
 
-# Expected enabled-lane density per parent state, by family (measured on
-# the BASELINE configs; used to size the per-family materialization
-# buffers — cap_f = chunk * min(n_lanes_f, density).  A chunk whose
-# enabled count exceeds a cap trips fovf and the engine grows that
-# family's cap and replays the level, so these are throughput tuning,
-# not correctness bounds.  Restart/Timeout are enabled for ~every
-# server in ~every state, so they get their full lane width.
-_FAMILY_DENSITY = {
-    "Restart": 1 << 30, "Timeout": 1 << 30,
-    "RequestVote": 2, "BecomeLeader": 1, "ClientRequest": 2,
-    "AdvanceCommitIndex": 2, "AppendEntries": 2,
-    "UpdateTerm": 2, "CocDiscard": 1, "Receive": 4,
-    "Duplicate": 4, "Drop": 4, "AddNewServer": 2, "DeleteServer": 2,
-}
-
-
-def validate_fam_density(density) -> Dict[str, int]:
+def validate_fam_density(density, ir=None) -> Dict[str, int]:
     """Bounds-validate a per-family density override mapping (the
     engines' ``fam_density`` kwarg / CLI ``--fam-cap-density``): known
-    family name, integer k >= 1.  Raises ValueError with a message fit
-    for the CLI — never a jit traceback."""
+    family name OF THE ACTIVE SPEC, integer k >= 1.  Raises ValueError
+    with a message fit for the CLI — never a jit traceback.  ``ir``
+    defaults to the raft frontend (the historical global table)."""
+    if ir is None:
+        from ..spec import get_spec
+        ir = get_spec("raft")
+    known = dict(ir.family_density)
     out = {}
     for name, k in dict(density or {}).items():
-        if name not in _FAMILY_DENSITY:
+        if name not in known:
             raise ValueError(
-                f"unknown action family {name!r} in fam-cap-density; "
-                f"known families: {', '.join(sorted(_FAMILY_DENSITY))}")
+                f"unknown action family {name!r} in fam-cap-density "
+                f"for spec {ir.name!r}; known families: "
+                f"{', '.join(sorted(known))}")
         if isinstance(k, bool) or not isinstance(k, int):
             raise ValueError(
                 f"fam-cap-density {name}: k must be an integer "
@@ -144,11 +85,12 @@ def validate_fam_density(density) -> Dict[str, int]:
     return out
 
 
-def parse_fam_density(spec: str) -> Dict[str, int]:
+def parse_fam_density(text: str, ir=None) -> Dict[str, int]:
     """Parse the CLI form ``fam=k,fam2=k2`` (``--fam-cap-density``)
-    into a validated override dict."""
+    into a validated override dict against the active spec's family
+    table (``ir``; raft when omitted)."""
     out = {}
-    for item in (spec or "").split(","):
+    for item in (text or "").split(","):
         item = item.strip()
         if not item:
             continue
@@ -164,7 +106,7 @@ def parse_fam_density(spec: str) -> Dict[str, int]:
                 f"fam-cap-density {name.strip()}: k must be an "
                 f"integer, got {val.strip()!r}") from None
         out[name.strip()] = k
-    return validate_fam_density(out)
+    return validate_fam_density(out, ir)
 
 
 class Expander:
@@ -180,11 +122,13 @@ class Expander:
     precision matrix products).  OFF restores the exact historical
     gather/vmap program — tests/test_guard_matmul.py pins ON ≡ OFF."""
 
-    def __init__(self, cfg: ModelConfig, guard_matmul: bool = True):
+    def __init__(self, cfg, guard_matmul: bool = True):
         self.cfg = cfg
-        self.lay = Layout(cfg)
-        self.kern = RaftKernels(self.lay)
-        self.families = build_families(self.lay)
+        self.ir = spec_of(cfg)
+        self.lay = self.ir.make_layout(cfg)
+        self.kern = self.ir.make_kernels(self.lay)
+        self.families = self.ir.build_families(self.lay)
+        self.keys = self.ir.all_keys
         self.n_lanes = sum(f.n_lanes for f in self.families)
         self.guard_matmul = bool(guard_matmul)
         self._gW, self._gT = self._build_guard_matrix()
@@ -195,79 +139,34 @@ class Expander:
     def _build_guard_matrix(self):
         """(W int8 [n_features, A], T int32 [A]): lane a's enabling
         guard is exactly ``φ(s) · W[:, a] == T[a]`` over the feature
-        vector of ops/kernels.guard_features.
+        vector of the spec kernels' ``guard_features``.
 
         Guards that are pure conjunctions of features select them with
         +1 weights and threshold = the conjunct count; a negated
-        conjunct (AddNewServer's ``j ∉ config``) enters with weight -1
-        and no threshold contribution — integer arithmetic, so the
-        compare is exact, never approximate.  A family without a row
-        here fails loudly: new actions must declare their guard
-        algebra, silently falling back would fork the two paths."""
-        from ..ops.kernels import guard_feature_offsets
-        OFF = guard_feature_offsets(self.lay)
-        S = self.lay.S
+        conjunct (raft AddNewServer's ``j ∉ config``) enters with
+        weight -1 and no threshold contribution — integer arithmetic,
+        so the compare is exact, never approximate.  The rows come
+        from each family's ``guard`` declaration (the SpecIR contract);
+        a family without one fails loudly here: new actions must
+        declare their guard algebra, silently falling back would fork
+        the two paths."""
+        OFF = self.kern.guard_feature_offsets()
         Wm = np.zeros((OFF["total"], self.n_lanes), np.int8)
         T = np.zeros((self.n_lanes,), np.int32)
         lane = 0
         for fam in self.families:
-            lanes = list(zip(*fam.params))
-            for vals in lanes:
+            if fam.guard is None:
+                raise KeyError(
+                    f"no guard algebra declared for action family "
+                    f"{fam.name!r} of spec {self.ir.name!r} — set the "
+                    f"Family.guard declaration in the spec's "
+                    f"build_families (spec/{self.ir.name}*)")
+            for vals in zip(*fam.params) if fam.params else [()]:
                 vals = tuple(int(v) for v in vals)
-                if fam.name == "RequestVote":
-                    i, j = vals
-                    Wm[OFF["cand"] + i, lane] = 1
-                    Wm[OFF["needvote"] + i * S + j, lane] = 1
-                    T[lane] = 2
-                elif fam.name == "BecomeLeader":
-                    (i,) = vals
-                    Wm[OFF["cand"] + i, lane] = 1
-                    Wm[OFF["blq"] + i, lane] = 1
-                    T[lane] = 2
-                elif fam.name in ("ClientRequest", "AdvanceCommitIndex"):
-                    i = vals[0]
-                    Wm[OFF["leader"] + i, lane] = 1
-                    T[lane] = 1
-                elif fam.name == "AppendEntries":
-                    i, j = vals
-                    Wm[OFF["leader"] + i, lane] = 1
-                    Wm[OFF["cfg"] + i * S + j, lane] = 1
-                    T[lane] = 2
-                elif fam.name == "Timeout":
-                    (i,) = vals
-                    Wm[OFF["folc"] + i, lane] = 1
-                    Wm[OFF["cfg"] + i * S + i, lane] = 1
-                    T[lane] = 2
-                elif fam.name == "Restart":
-                    T[lane] = 0          # unconditionally enabled
-                elif fam.name == "UpdateTerm":
-                    Wm[OFF["ut"] + vals[0], lane] = 1
-                    T[lane] = 1
-                elif fam.name == "CocDiscard":
-                    Wm[OFF["cocd"] + vals[0], lane] = 1
-                    T[lane] = 1
-                elif fam.name == "Receive":
-                    Wm[OFF["recv"] + vals[0], lane] = 1
-                    T[lane] = 1
-                elif fam.name in ("Duplicate", "Drop"):
-                    Wm[OFF["cnt1"] + vals[0], lane] = 1
-                    T[lane] = 1
-                elif fam.name == "AddNewServer":
-                    i, j = vals
-                    Wm[OFF["leader"] + i, lane] = 1
-                    Wm[OFF["cfg"] + i * S + j, lane] = -1   # j ∉ config
-                    T[lane] = 1
-                elif fam.name == "DeleteServer":
-                    i, j = vals
-                    Wm[OFF["leader"] + i, lane] = 1
-                    Wm[OFF["folc"] + j, lane] = 1
-                    Wm[OFF["cfg"] + i * S + j, lane] = 1
-                    T[lane] = 3
-                else:
-                    raise KeyError(
-                        f"no guard-matrix row for family {fam.name!r} "
-                        "— declare its guard algebra in "
-                        "Expander._build_guard_matrix")
+                pairs, thresh = fam.guard(OFF, self.lay, *vals)
+                for idx, w in pairs:
+                    Wm[idx, lane] = w
+                T[lane] = thresh
                 lane += 1
         assert lane == self.n_lanes
         return Wm, T
@@ -296,7 +195,7 @@ class Expander:
                 cands.append(sv2)
             ok = jnp.concatenate([o.reshape(-1) for o in oks])
             cand = {k: jnp.concatenate([c[k] for c in cands], axis=0)
-                    for k in ALL_KEYS}
+                    for k in self.keys}
             return ok, cand
 
         return jax.vmap(one_state)(svb)
@@ -319,12 +218,13 @@ class Expander:
     def default_fam_caps(self, chunk: int,
                          density=None) -> Tuple[int, ...]:
         """Per-family materialization caps: chunk × min(lanes, density).
-        ``density`` overrides _FAMILY_DENSITY per family (the engines'
-        ``fam_density`` kwarg / ``--fam-cap-density`` — validated by
-        validate_fam_density, so cap-overflow replays are tunable
-        without editing this module)."""
-        d = dict(_FAMILY_DENSITY)
-        d.update(validate_fam_density(density))
+        ``density`` overrides the spec's family_density table per
+        family (the engines' ``fam_density`` kwarg /
+        ``--fam-cap-density`` — validated by validate_fam_density, so
+        cap-overflow replays are tunable without editing any spec
+        module)."""
+        d = dict(self.ir.family_density)
+        d.update(validate_fam_density(density, self.ir))
         return tuple(
             chunk * min(f.n_lanes, d.get(f.name, 2))
             for f in self.families)
@@ -505,7 +405,7 @@ class Expander:
                     fam.name, tables, b_idx, sv_rows, sv2, prm_rows))
             off += nf
         concat = {k: jnp.concatenate([o[k] for o in outs], axis=-1)
-                  for k in ALL_KEYS}
+                  for k in self.keys}
         take = jnp.clip(mapidx, 0, totc - 1)
         cand = {k: v[..., take] for k, v in concat.items()}
         if delta_fp is None:
@@ -557,6 +457,6 @@ class Expander:
         labels = self.lane_labels()
         out = []
         for lane in np.nonzero(ok)[0]:
-            sv2 = {k: np.asarray(cand[k])[0, lane] for k in ALL_KEYS}
+            sv2 = {k: np.asarray(cand[k])[0, lane] for k in self.keys}
             out.append((labels[lane], sv2))
         return out

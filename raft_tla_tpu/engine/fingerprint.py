@@ -51,7 +51,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import CONFIG_ENTRY, MT_COC, NIL, ModelConfig
-from ..models.explore import symmetry_perms
 from ..ops.kernels import RaftKernels
 from ..ops.layout import Layout, get_field, put_field
 
@@ -73,7 +72,17 @@ def _salts(n: int, stream: int) -> np.ndarray:
     return rng.randint(0, 1 << 32, size=n, dtype=np.uint32)
 
 
-class Fingerprinter:
+def Fingerprinter(cfg):
+    """Factory: the active spec's symmetry-canonical fingerprinter
+    (``spec_of(cfg).make_fingerprinter`` — RaftFingerprinter below for
+    raft, spec/paxos/fingerprint.PaxosFingerprinter for paxos).  Kept
+    under the historical class name so every engine/tool call site
+    reads unchanged."""
+    from ..spec import spec_of
+    return spec_of(cfg).make_fingerprinter(cfg)
+
+
+class RaftFingerprinter:
     def __init__(self, cfg: ModelConfig):
         self.cfg = cfg
         self.lay = Layout(cfg)
@@ -87,7 +96,10 @@ class Fingerprinter:
         self.bag_salts = [_salts(self.lay.msg_words + 1, 16 + t)
                           for t in range(self.n_streams)]
         if cfg.symmetry:
-            perms = symmetry_perms(cfg)
+            # the spec's symmetry group (SpecIR handle — the oracle
+            # twin models/explore.symmetry_perms for raft)
+            from ..spec import spec_of
+            perms = spec_of(cfg).symmetry_perms(cfg)
         else:
             perms = [tuple(range(S))]
         self.sigmas = np.array(perms, dtype=np.int32)           # [P, S]

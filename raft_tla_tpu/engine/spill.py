@@ -62,8 +62,7 @@ import numpy as np
 from jax import lax
 
 from ..config import ModelConfig
-from ..models.raft import init_state
-from ..ops.codec import C_OVERFLOW, decode, encode, narrow, widen
+from ..ops.codec import C_OVERFLOW
 from ..obs import NULL_OBS
 from .bfs import (CheckResult, CheckpointError, Engine, U32MAX,
                   _HOME_SALT, Violation, ckpt_read, ckpt_result,
@@ -180,7 +179,7 @@ class SpillEngine(Engine):
         OCAP = carry["oidx"].shape[0]
         VCAP = carry["vis"][0].shape[0]
         base = carry["base"]
-        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B,
+        sv = self.ir.widen({k: lax.dynamic_slice_in_dim(v, base, B,
                                                 axis=v.ndim - 1)
                     for k, v in carry["front"].items()})
         # no fmask: constraint-pruned rows never enter the frontier
@@ -228,7 +227,7 @@ class SpillEngine(Engine):
         rows = lax.optimization_barrier(
             {k: cand_c[k][..., lidx] for k in cand_c})
         inv, con = lax.optimization_barrier(self._phase2_T(rows))
-        rows_n = narrow(self.lay, rows)
+        rows_n = self.ir.narrow(self.lay, rows)
         lvl = {k: lax.dynamic_update_slice_in_dim(
                    v, rows_n[k], start, v.ndim - 1)
                for k, v in carry["lvl"].items()}
@@ -270,7 +269,8 @@ class SpillEngine(Engine):
     # ------------------------------------------------------------------
 
     def _fresh_spill_carry(self):
-        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
+        one = self.ir.narrow(self.lay, self.ir.encode(
+            self.lay, *self.ir.init_state(self.cfg)))
         lvl = {k: jnp.zeros(v.shape + (self.SEGL,), dtype=v.dtype)
                for k, v in one.items()}
         front = {k: jnp.zeros(v.shape + (self.SEGF,), dtype=v.dtype)
@@ -310,7 +310,8 @@ class SpillEngine(Engine):
         """Fresh level-segment buffers at the CURRENT self.SEGL/FCAP
         (used after a cap growth changed shapes; plain n_lvl reset
         suffices otherwise)."""
-        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
+        one = self.ir.narrow(self.lay, self.ir.encode(
+            self.lay, *self.ir.init_state(self.cfg)))
         carry["lvl"] = {k: jnp.zeros(v.shape + (self.SEGL,),
                                      dtype=v.dtype)
                         for k, v in one.items()}
@@ -697,7 +698,8 @@ class SpillEngine(Engine):
             rows_cat, gids_cat = self._cat_seg(
                 [r for r, _g in frontier_blocks],
                 [g for _r, g in frontier_blocks])
-            one = narrow(lay, encode(lay, *init_state(self.cfg)))
+            one = self.ir.narrow(lay, self.ir.encode(
+                lay, *self.ir.init_state(self.cfg)))
             fr_np = {k: np.zeros(v.shape + (KB,), v.dtype)
                      for k, v in one.items()}
             for k in fr_np:
@@ -758,7 +760,7 @@ class SpillEngine(Engine):
                             for k, v in st_h.items()}
                     for j, nm in enumerate(self.inv_names):
                         for s in np.nonzero(~inv_h[j, li, :n_lvl])[0]:
-                            vsv, vh = decode(
+                            vsv, vh = self.ir.decode(
                                 lay, {kk: np.asarray(rows[kk][s])
                                       for kk in rows})
                             res.violations.append(Violation(
@@ -856,7 +858,8 @@ class SpillEngine(Engine):
             inv_r, con_r = (np.asarray(a) for a in self._phase2(
                 {k: jnp.asarray(v) for k, v in roots.items()}))
             roots_T = {k: np.moveaxis(v, 0, -1)
-                       for k, v in narrow(lay, roots).items()}
+                       for k, v in self.ir.narrow(lay,
+                                                  roots).items()}
             root_blk = dict(rows=roots_T,
                             lpar=np.full((n_roots,), -1, np.int32),
                             llane=np.full((n_roots,), -1, np.int32),
@@ -905,7 +908,8 @@ class SpillEngine(Engine):
                 bad = np.nonzero(~inv_ok)
                 res.violations_global += len(bad[0])
                 for j, s in zip(*bad):
-                    vsv, vh = decode(lay, _take_last(blk["rows"], s))
+                    vsv, vh = self.ir.decode(
+                        lay, _take_last(blk["rows"], s))
                     res.violations.append(Violation(
                         self.inv_names[j], int(gids[s]),
                         state=vsv, hist=vh))
@@ -1300,14 +1304,18 @@ class SpillEngine(Engine):
                        fam_caps=list(self.FAM_CAPS),
                        host_table=self.host_table,
                        partitions=self.partitions, **arch_meta,
-                       layout=2, chunk=self.chunk, cfg=repr(self.cfg)))
+                       layout=2, chunk=self.chunk,
+                       spec=self.ir.name,
+                       ir_fingerprint=self.ir.fingerprint(),
+                       cfg=repr(self.cfg)))
 
     def _load_spill_checkpoint(self, path):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
                             self._SPILL_EXTRA_KEYS,
                             sharded=False, spill=True, expected_format=(
                                 "layout", 2, "this engine's batch-last/"
-                                "narrow-dtype storage layout"))
+                                "narrow-dtype storage layout"),
+                            spec_name=self.ir.name)
         if meta["SEGF"] != self.SEGF:
             # frontier re-segmentation is count-preserving (first-seen
             # is parent-order invariant), but a resumed run should be

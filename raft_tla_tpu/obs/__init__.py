@@ -57,11 +57,16 @@ class Obs:
     def __init__(self, spans: Optional[SpanRecorder] = None,
                  ledger: Optional[RunLedger] = None,
                  heartbeat: Optional[Heartbeat] = None,
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None,
+                 meta: Optional[Dict] = None):
         self.spans = spans
         self.ledger = ledger
         self.heartbeat = heartbeat
         self.profile_dir = profile_dir
+        # run-constant stamp merged into every ledger record (the CLI
+        # passes the active spec name + IR fingerprint here, so every
+        # dispatch line names the frontend that compiled the run)
+        self.meta = dict(meta or {})
         self._profiling = False
         self._t0 = time.perf_counter()
         self._n_dispatch = 0
@@ -99,6 +104,7 @@ class Obs:
             # `depth` counter is only finalized at run end, so the
             # dispatch-passed depth must win
             rec = dict(metrics)
+            rec.update(self.meta)
             rec["kind"] = kind
             rec["depth"] = int(depth)
             rec["frontier"] = int(frontier)
@@ -163,7 +169,8 @@ NULL_OBS = Obs()
 def from_flags(ledger: Optional[str] = None,
                heartbeat: Optional[str] = None,
                timeline: Optional[str] = None,
-               profile_dir: Optional[str] = None) -> Obs:
+               profile_dir: Optional[str] = None,
+               meta: Optional[Dict] = None) -> Obs:
     """Build the bundle the CLI flags describe (NULL_OBS when none are
     set, so callers can pass the result unconditionally)."""
     if not (ledger or heartbeat or timeline or profile_dir):
@@ -173,4 +180,4 @@ def from_flags(ledger: Optional[str] = None,
         else None,
         ledger=RunLedger(ledger) if ledger else None,
         heartbeat=Heartbeat(heartbeat) if heartbeat else None,
-        profile_dir=profile_dir)
+        profile_dir=profile_dir, meta=meta)

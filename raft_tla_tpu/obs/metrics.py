@@ -107,7 +107,9 @@ class MetricsRegistry:
 
 
 def check_stats(counters: Mapping, seconds: float, n_violations: int,
-                fp_bits: Optional[int] = None) -> Dict[str, object]:
+                fp_bits: Optional[int] = None,
+                spec: Optional[str] = None,
+                ir_fp: Optional[str] = None) -> Dict[str, object]:
     """The ``check`` stats payload (stdout line and ``--stats-json``),
     assembled from a counter mapping (``CheckResult.metrics.as_dict()``
     for the engines; a hand-built dict for the oracle, which has no
@@ -148,6 +150,14 @@ def check_stats(counters: Mapping, seconds: float, n_violations: int,
         # kernel) — .get: pre-round-9 counter dicts lack them
         for k in MXU_COUNTER_KEYS:
             out[k] = int(counters.get(k, 0) or 0)
+    if spec is not None:
+        # the active SpecIR name + structure fingerprint (spec/
+        # package) — appended last so the pre-IR key prefix stays
+        # byte-identical; present for the oracle engine too (the spec
+        # is a frontend property, not an engine one)
+        out["spec"] = spec
+        if ir_fp is not None:
+            out["ir_fingerprint"] = ir_fp
     return out
 
 

@@ -61,8 +61,11 @@ from ..models.raft import Hist, State
 from .layout import (Layout, MSG_FIELDS, get_field, pack_entry,
                      put_field_checked, unpack_entry)
 
-NCTR = 8
-C_NLEADERS, C_NREQ, C_NTRIED, C_NMC, C_GLOBLEN, C_OVERFLOW = range(6)
+# the shared cross-spec ctr-lane contract now lives in the spec
+# package (every SpecIR's encoded state carries the same ctr vector);
+# aliased here for the historical import path
+from ..spec import (C_GLOBLEN, C_NLEADERS, C_NMC, C_NREQ,   # noqa: F401
+                    C_NTRIED, C_OVERFLOW, NCTR)
 
 NFEAT = 12
 (F_COMMIT_SEEN, F_BL2_SEEN, F_CWCL_POS, F_LAST_RESTART_POS,

@@ -107,6 +107,11 @@ class RaftKernels:
         return {"config": config, "maxcfg": maxcfg, "lastterm": lastterm,
                 "leaders": leaders}
 
+    def guard_feature_offsets(self) -> Dict[str, int]:
+        """The SpecIR kernels contract: the flat layout of this spec's
+        ``guard_features`` vector (module-level table below)."""
+        return guard_feature_offsets(self.lay)
+
     # ------------------------------------------------------------------
     # Entry / message packing helpers (device side)
     # ------------------------------------------------------------------

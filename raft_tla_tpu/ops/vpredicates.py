@@ -331,6 +331,27 @@ class Predicates:
     def constraint_fn(self, name: str) -> Callable:
         return CONSTRAINTS[name].__get__(self)
 
+    def action_fn(self, name: str) -> Callable:
+        """ACTION_CONSTRAINT device form: (parent_sv, cand_sv) -> ok
+        (raft.tla:1207-1210 semantics — a violating transition is not
+        generated).  Moved here from the engines' hard-wired _act_ok so
+        the name registry is part of the spec surface."""
+        try:
+            return ACTION_CONSTRAINTS_V[name].__get__(self)
+        except KeyError:
+            raise KeyError(
+                f"unknown action constraint {name!r} for spec 'raft'; "
+                f"known: {', '.join(sorted(ACTION_CONSTRAINTS_V))}"
+            ) from None
+
+    def commit_when_concurrent_leaders_action_constraint(
+            self, parent_sv, cand_sv):
+        """raft.tla:1207-1210: past trace length 20, kill transitions
+        that leave any candidate alive (punctuated-search pruning)."""
+        deep = parent_sv["ctr"][C_GLOBLEN] >= 20
+        no_cand = jnp.all(cand_sv["st"] != CANDIDATE)
+        return ~deep | no_cand
+
 
 INVARIANTS: Dict[str, Callable] = {
     "LeaderVotesQuorum": Predicates.leader_votes_quorum,
@@ -397,6 +418,11 @@ SCENARIO_PROPERTIES = (
 for _nm in SCENARIO_PROPERTIES:
     assert _nm in INVARIANTS, \
         f"scenario property {_nm!r} has no device predicate"
+
+ACTION_CONSTRAINTS_V: Dict[str, Callable] = {
+    "CommitWhenConcurrentLeaders_action_constraint":
+        Predicates.commit_when_concurrent_leaders_action_constraint,
+}
 
 CONSTRAINTS: Dict[str, Callable] = {
     "BoundedInFlightMessages": Predicates.bounded_in_flight_messages,

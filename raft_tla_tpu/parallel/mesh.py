@@ -87,9 +87,7 @@ from ..obs import NULL_OBS
 from ..engine.bfs import (CheckResult, Engine, U32MAX, Violation, _cat,
                           _take, ckpt_archives, ckpt_carry, ckpt_read,
                           ckpt_result, ckpt_write)
-from ..models.raft import init_state
-from ..ops.codec import C_OVERFLOW, NONVIEW_KEYS, decode, encode, \
-    narrow, widen
+from ..ops.codec import C_OVERFLOW
 
 # sharded checkpoint format gate (shared with MultiHostEngine):
 # format 2 added the content-canonical lrow table (round 4); format 3
@@ -230,7 +228,7 @@ class ShardedEngine(Engine):
         M = D * SC                     # received candidates per step
         base = c["base"]
         # frontier shards are stored narrow; widen the chunk for kernels
-        sv = widen({k: lax.dynamic_slice_in_dim(v, base, B)
+        sv = self.ir.widen({k: lax.dynamic_slice_in_dim(v, base, B)
                     for k, v in c["front"].items()})
         fmask = lax.dynamic_slice_in_dim(c["fmask"], base, B)
         # guard-first expansion (engine/bfs chunk-step twin).  The
@@ -304,7 +302,7 @@ class ShardedEngine(Engine):
                          for w in range(W))
         # rows ride the ICI all_to_all in storage dtypes (2-3x fewer
         # interconnect bytes than the kernels' int32 rows)
-        send_row = narrow(self.lay, {k: v[stake]
+        send_row = self.ir.narrow(self.lay, {k: v[stake]
                                      for k, v in cand_c.items()})
         send_pgid = jnp.where(sfill, pgid[stake], -1)
         send_lane = jnp.where(sfill, lane[stake], -1)
@@ -354,7 +352,7 @@ class ShardedEngine(Engine):
         # the module docstring's determinism contract.
         def content_words(rows_nv):
             ws = []
-            for k in NONVIEW_KEYS:
+            for k in self.ir.nonview_keys:
                 v = rows_nv[k].astype(jnp.int32).reshape(M, -1)
                 for ci in range(v.shape[1]):
                     ws.append(v[:, ci].astype(jnp.uint32)
@@ -431,7 +429,7 @@ class ShardedEngine(Engine):
         # their own lane (counter-reading scenario predicates must
         # re-evaluate on the surviving representative's content)
         inv_all, con_all = lax.optimization_barrier(
-            self._phase2_impl(widen(recv_row)))
+            self._phase2_impl(self.ir.widen(recv_row)))
         inv, con = inv_all[lidx], con_all[lidx]
         lvl = {k: lax.dynamic_update_slice_in_dim(v, rows[k], start, 0)
                for k, v in c["lvl"].items()}
@@ -762,7 +760,8 @@ class ShardedEngine(Engine):
 
     def _fresh_sharded_carry(self):
         D, LB, VB, FC = self.D, self.LB, self.VB, self.FC
-        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
+        one = self.ir.narrow(self.lay, self.ir.encode(
+            self.lay, *self.ir.init_state(self.cfg)))
         zeros = {k: jnp.zeros((D, LB) + v.shape, dtype=v.dtype)
                  for k, v in one.items()}
         n_inv = len(self.inv_names)
@@ -963,7 +962,7 @@ class ShardedEngine(Engine):
                 for d, inv_ok in inv_shards:
                     for j, nm in enumerate(self.inv_names):
                         for s in np.nonzero(~inv_ok[:nl[d], j])[0]:
-                            vsv, vh = decode(lay, _take(
+                            vsv, vh = self.ir.decode(lay, _take(
                                 {k: rows[k][d] for k in rows}, s))
                             res.violations.append(Violation(
                                 nm, n_states + int(prefix[d]) + int(s),
@@ -1060,7 +1059,8 @@ class ShardedEngine(Engine):
                                 for j, nm in enumerate(self.inv_names):
                                     for s in np.nonzero(
                                             ~inv_ok[li, :nl[d], j])[0]:
-                                        vsv, vh = decode(lay, _take(
+                                        vsv, vh = self.ir.decode(
+                                            lay, _take(
                                             {k: st_rows[k][d][li]
                                              for k in st_rows}, s))
                                         res.violations.append(
@@ -1246,7 +1246,10 @@ class ShardedEngine(Engine):
                            fam_caps=list(self.FAM_CAPS),
                            depth=depth, n_states=n_states,
                            n_vis=[int(x) for x in n_vis],
-                           n_front=int(n_front), cfg=repr(self.cfg)))
+                           n_front=int(n_front),
+                           spec=self.ir.name,
+                           ir_fingerprint=self.ir.fingerprint(),
+                           cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
         from ..engine.bfs import CheckpointError
@@ -1257,7 +1260,8 @@ class ShardedEngine(Engine):
                 "multi-process runs")
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
                             ("D", "LB", "VB", "FC", "SC", "fam_caps"),
-                            sharded=True, expected_format=_SHARDED_FMT)
+                            sharded=True, expected_format=_SHARDED_FMT,
+                            spec_name=self.ir.name)
         if meta["D"] != self.D:
             raise CheckpointError(
                 f"checkpoint was written on a {meta['D']}-device mesh; "
@@ -1327,8 +1331,7 @@ class ShardedEngine(Engine):
             _ok, _cand, fp = self._phase1_impl(svb_local)
             return jax.lax.all_gather(fp, "d", tiled=True)
 
-        from ..ops.codec import ALL_KEYS
         fn = _shard_map(
             local, self.mesh,
-            ({k: P("d") for k in ALL_KEYS},), P(None))
+            ({k: P("d") for k in self.ir.all_keys},), P(None))
         return fn(svb)

@@ -252,7 +252,10 @@ class MultiHostEngine(ShardedEngine):
                        fam_caps=list(self.FAM_CAPS),
                        depth=depth, n_states=n_states,
                        n_vis=[int(x) for x in n_vis],
-                       n_front=int(n_front), cfg=repr(self.cfg)))
+                       n_front=int(n_front),
+                       spec=self.ir.name,
+                       ir_fingerprint=self.ir.fingerprint(),
+                       cfg=repr(self.cfg)))
 
     def _load_checkpoint(self, path):
         from .mesh import _SHARDED_FMT
@@ -260,7 +263,8 @@ class MultiHostEngine(ShardedEngine):
                             self.chunk,
                             ("D", "n_proc", "proc", "d_idx", "LB", "VB",
                              "FC", "SC", "fam_caps"), sharded=True,
-                            expected_format=_SHARDED_FMT)
+                            expected_format=_SHARDED_FMT,
+                            spec_name=self.ir.name)
         if meta["n_proc"] != jax.process_count() or \
                 meta["D"] != self.D:
             raise CheckpointError(

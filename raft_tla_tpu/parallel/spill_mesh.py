@@ -53,8 +53,7 @@ from ..engine.bfs import CheckResult, U32MAX, Violation
 from ..obs import NULL_OBS
 from ..engine.host_table import HostPartitionedTable, insert_np
 from ..engine.spill import SpillEngine
-from ..models.raft import init_state
-from ..ops.codec import C_OVERFLOW, decode, encode, narrow
+from ..ops.codec import C_OVERFLOW
 from .mesh import P, ShardedEngine, _shard_map
 
 # summary row layout ([D, Z_LEN + n_fams] int32, replicated)
@@ -217,7 +216,8 @@ class SpilledShardedEngine(ShardedEngine):
         ns = [0 if s is None else int(s[1].shape[0]) for s in seg]
         nq = SpillEngine._quantize(max(max(ns), 1), self.LB,
                                   floor=1 << 8)
-        one = narrow(self.lay, encode(self.lay, *init_state(self.cfg)))
+        one = self.ir.narrow(self.lay, self.ir.encode(
+            self.lay, *self.ir.init_state(self.cfg)))
         rows_np = {k: np.zeros((self.D, nq) + v.shape, v.dtype)
                    for k, v in one.items()}
         gids_np = np.full((self.D, nq), -1, np.int32)
@@ -318,7 +318,7 @@ class SpilledShardedEngine(ShardedEngine):
             per_dev[int(rk[r, W - 1]) % D].append(r)
         inv_r, con_r = (np.asarray(a) for a in self._phase2(
             {k: jnp.asarray(v) for k, v in roots.items()}))
-        roots_n = narrow(lay, roots)
+        roots_n = self.ir.narrow(lay, roots)
 
         if self.host_table:
             self.hpts = [HostPartitionedTable(
@@ -377,7 +377,7 @@ class SpilledShardedEngine(ShardedEngine):
                     bad = np.nonzero(~inv_ok)
                     res.violations_global += len(bad[0])
                     for s, j in zip(*bad):
-                        vsv, vh = decode(lay, {
+                        vsv, vh = self.ir.decode(lay, {
                             k: np.asarray(v[s])
                             for k, v in blk["rows"].items()})
                         res.violations.append(Violation(
@@ -727,7 +727,7 @@ class SpilledShardedEngine(ShardedEngine):
                 if stats[d, li, 1]:
                     inv_ok = inv_h[d, li, :nl[d]]
                     for s, j in zip(*np.nonzero(~inv_ok)):
-                        vsv, vh = decode(lay, {
+                        vsv, vh = self.ir.decode(lay, {
                             k: np.asarray(st_h[k][d, li, s])
                             for k in st_h})
                         res.violations.append(Violation(

@@ -78,12 +78,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..config import LEADER, ModelConfig
-from ..models.raft import Hist, State, init_state
-from ..ops.codec import C_NLEADERS, C_NMC, decode, encode
-from ..ops.kernels import RaftKernels, select_enabled
-from ..ops.layout import Layout
-from ..ops.vpredicates import Predicates
+from ..config import ModelConfig
+from ..ops.kernels import select_enabled
+from ..spec import spec_of
 from ..engine.expand import Expander
 from ..engine.bfs import enable_persistent_compilation_cache
 from ..engine.fingerprint import (Fingerprinter, bloom_estimate,
@@ -104,9 +101,10 @@ class WalkerHit:
     walker: int                  # global walker id
     depth: int                   # steps from the root (witness length)
     lanes: List[int]             # flat lane ids root -> hit state
-    trace: List[Tuple[str, State]] = field(default_factory=list)
+    # (label, oracle-state) chain — the active spec's state type
+    trace: List[Tuple] = field(default_factory=list)
     state_arrs: Optional[Dict[str, np.ndarray]] = None
-    hist: Optional[Hist] = None
+    hist: Optional[object] = None
 
 
 @dataclass
@@ -154,10 +152,6 @@ def dispatch_counters(stats2d: np.ndarray, walkers: int):
         "hits": int(stats2d[:, ST_HIT].sum()),
     }
 
-_SCORE_LEADER = 1 << 20
-_SCORE_NMC = 1 << 10
-
-
 class SimEngine:
     """W-walker random-walk explorer bound to one ModelConfig.
 
@@ -194,8 +188,9 @@ class SimEngine:
         self.policy = policy
         self.bloom_bits = int(bloom_bits)
         self.wid_base = int(wid_base)
-        self.lay = Layout(cfg)
-        self.kern = RaftKernels(self.lay)
+        self.ir = spec_of(cfg)
+        self.lay = self.ir.make_layout(cfg)
+        self.kern = self.ir.make_kernels(self.lay)
         # the sim engine reuses select_enabled over the SAME guard grid
         # the exhaustive engines dispatch on, so the MXU guard-matrix
         # path (engine/expand docstring) drops in here unchanged:
@@ -207,18 +202,24 @@ class SimEngine:
         fp_cfg = cfg
         self.bloom_canonical = True
         if cfg.symmetry:
-            from ..models.explore import symmetry_perms
-            if len(symmetry_perms(cfg)) > _BLOOM_CANONICAL_MAX_PERMS:
+            if len(self.ir.symmetry_perms(cfg)) > \
+                    _BLOOM_CANONICAL_MAX_PERMS:
                 fp_cfg = cfg.with_(symmetry=False)
                 self.bloom_canonical = False
         self.fpr = Fingerprinter(fp_cfg)
-        self.preds = Predicates(self.lay)
+        self.preds = self.ir.make_predicates(self.lay)
+        # punctuated-restart progress ladder: a SpecIR hook (the raft
+        # scenario ladder lives in spec/raft_ir.sim_progress); a spec
+        # without one degrades punctuated to budget-only restarts
+        self._progress_fn = (self.ir.sim_progress(self.kern, self.lay)
+                             if self.ir.sim_progress else None)
         self.inv_names = list(cfg.invariants)
         self.con_names = list(cfg.constraints)
         self.act_names = list(cfg.action_constraints)
         self.labels = self.expander.lane_labels()
         self.A = self.expander.n_lanes
-        self._root = encode(self.lay, *init_state(cfg))
+        self._root = self.ir.encode(self.lay,
+                                    *self.ir.init_state(cfg))
         self._dispatch = jax.jit(self._dispatch_impl, donate_argnums=0,
                                  static_argnums=(1, 2))
 
@@ -268,23 +269,15 @@ class SimEngine:
         return jax.vmap(one, in_axes=-1, out_axes=-1)(svT)
 
     def _progress_T(self, svT) -> jnp.ndarray:
-        """Monotone scenario-ladder score [W]: leader elected <
-        membership changes appended < latest-ConfigEntry replication
-        count at a current leader.  Drives the ``punctuated`` restart
-        bases; never consulted under ``tlc``."""
-        S = self.lay.S
-        derT = jax.vmap(self.kern.derived, in_axes=-1,
-                        out_axes=-1)(svT)
-        leader_seen = (svT["ctr"][C_NLEADERS] > 0).astype(jnp.int32)
-        nmc = svT["ctr"][C_NMC]
-        maxcfg = derT["maxcfg"]                       # [S, W]
-        repl = jnp.sum(svT["mi"] >= maxcfg[:, None, :],
-                       axis=1, dtype=jnp.int32)       # [S, W]
-        is_l = (svT["st"] == LEADER) & (maxcfg > 0)
-        repl = jnp.max(jnp.where(is_l, repl, 0), axis=0)
-        return leader_seen * _SCORE_LEADER + \
-            jnp.minimum(nmc, _SCORE_LEADER // _SCORE_NMC - 1) * \
-            _SCORE_NMC + jnp.minimum(repl, _SCORE_NMC - 1)
+        """Monotone scenario-ladder score [W] (the SpecIR sim_progress
+        hook — raft: leader elected < membership changes appended <
+        ConfigEntry replication; paxos: phase ladder).  Drives the
+        ``punctuated`` restart bases; never consulted under ``tlc``.
+        A spec without the hook scores every state 0 (punctuated
+        degrades to budget-only restarts from the root)."""
+        if self._progress_fn is None:
+            return jnp.zeros((self.W,), jnp.int32)
+        return self._progress_fn(svT)
 
     # ------------------------------------------------------------------
     # the fused step (shared by the single-device dispatch and the
@@ -576,8 +569,8 @@ class SimEngine:
 
     def decode_hit(self, h: WalkerHit) -> WalkerHit:
         arrs = {k: np.asarray(v) for k, v in self._root.items()}
-        chain: List[Tuple[str, State]] = [
-            ("Init", decode(self.lay, arrs)[0])]
+        chain: List[Tuple] = [
+            ("Init", self.ir.decode(self.lay, arrs)[0])]
         for lane in h.lanes:
             enabled = self.expander.expand_one(arrs)
             match = [sv2 for (lbl, sv2) in enabled
@@ -589,8 +582,8 @@ class SimEngine:
                     f"{len(chain) - 1}")
             arrs = match[0]
             chain.append((self.labels[lane],
-                          decode(self.lay, arrs)[0]))
+                          self.ir.decode(self.lay, arrs)[0]))
         h.trace = chain
         h.state_arrs = arrs
-        h.hist = decode(self.lay, arrs)[1]
+        h.hist = self.ir.decode(self.lay, arrs)[1]
         return h

@@ -1,0 +1,132 @@
+"""Symmetry-canonical Paxos fingerprints (acceptor-permutation VIEW).
+
+Same identity semantics as engine/fingerprint.RaftFingerprinter —
+fp(s) = min over the symmetry group of a salted positional hash of the
+VIEW (mb / vb / vv / msgs; ctr excluded) — but structurally far
+simpler, because the Paxos layout has NO label-carrying values:
+acceptor ids appear only as *positions* (the [I, N] columns and the
+acc-indexed 1b/2b message bits), never inside stored values.  The
+salt-permutation trick therefore covers the whole state: relabeling
+under σ is hashing the state in place against statically permuted salt
+tables (per-acceptor columns permute by σ(a); message-bit salts
+permute by the layout's perm_bit_map), with zero per-σ value
+rewriting.  Bit-identical to relabel-then-hash by the same commutative
+u32-sum argument.
+
+Streams: two independent 32-bit murmur-finalizer streams (64-bit
+identity), fp128 doubles them — identical to the raft stream model, so
+the engines' visited tables / Bloom filters are spec-blind.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...engine.fingerprint import fmix32, _salts
+from .layout import PaxosLayout
+from .model import symmetry_perms
+
+U32 = jnp.uint32
+
+
+class PaxosFingerprinter:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.lay = PaxosLayout(cfg)
+        lay = self.lay
+        self.n_streams = 4 if cfg.fp128 else 2
+        # positions: mb | vb | vv (I*N each) | message bits
+        self.n_scalar = 3 * lay.I * lay.N
+        self.n_pos = self.n_scalar + lay.n_msg_bits
+        self.pos_salts = [_salts(self.n_pos, 32 + t)
+                          for t in range(self.n_streams)]
+        perms = (symmetry_perms(cfg) if cfg.symmetry
+                 else [tuple(range(lay.N))])
+        self.sigmas = np.array(perms, dtype=np.int32)
+        # statically permuted salt tables (engine/fingerprint docstring
+        # for the algebra): psalts[p, t, i] is the salt position i's
+        # content hashes against under σ_p
+        idx = np.empty((len(perms), self.n_pos), dtype=np.int64)
+        ar = np.arange(lay.N)
+        for p, sig in enumerate(np.asarray(self.sigmas)):
+            off = 0
+            for _blk in range(3):                      # mb vb vv
+                for i in range(lay.I):
+                    base = off + i * lay.N
+                    idx[p, base:base + lay.N] = base + sig[ar]
+                off += lay.I * lay.N
+            idx[p, off:] = off + lay.perm_bit_map(sig)
+        self.psalts = np.stack(
+            [np.stack([self.pos_salts[t][idx[p]]
+                       for t in range(self.n_streams)])
+             for p in range(len(perms))])       # [P, n_streams, n_pos]
+
+    def supports_incremental(self) -> bool:
+        """No incremental-delta path yet: Paxos configs are small and
+        symmetry groups tiny (N! at N<=5); the direct positional sum is
+        already cheap.  The engines fall back automatically."""
+        return False
+
+    # ------------------------------------------------------------------
+
+    def _core(self, svT: Dict, nb: int) -> jnp.ndarray:
+        lay = self.lay
+        tail = (1,) * nb
+        words = svT["msgs"]                            # [MW, ...]
+        j = np.arange(lay.n_msg_bits)
+        sh = jnp.asarray((j & 31).astype(np.uint32)).reshape(
+            (lay.n_msg_bits,) + tail)
+        bits = ((words[j >> 5] >> sh) & U32(1)).astype(U32)
+        scal = [svT["mb"], svT["vb"], svT["vv"]]
+        flat = jnp.concatenate(
+            [p.reshape((-1,) + p.shape[p.ndim - nb:]).astype(U32)
+             for p in scal] + [bits])                  # [n_pos, ...]
+
+        def one_perm(psalt):
+            out = []
+            for t in range(self.n_streams):
+                h = jnp.sum(fmix32(flat ^ psalt[t].reshape(
+                    (self.n_pos,) + tail)), axis=0)
+                out.append(h)
+            return jnp.stack(out)                      # [n_streams, ...]
+
+        hs = jax.vmap(one_perm)(jnp.asarray(self.psalts))
+        return self._seal(self._lex_min(hs))
+
+    def _lex_min(self, hs) -> jnp.ndarray:
+        best = hs[0]
+        for p in range(1, hs.shape[0]):
+            cand = hs[p]
+            less = jnp.zeros(best.shape[1:], bool)
+            eq = jnp.ones(best.shape[1:], bool)
+            for t in range(self.n_streams):
+                less = less | (eq & (cand[t] < best[t]))
+                eq = eq & (cand[t] == best[t])
+            best = jnp.where(less, cand, best)
+        return best
+
+    def _seal(self, best):
+        """All-ones fingerprints alias the visited tables' empty-slot
+        sentinel; remap exactly like the raft sealer."""
+        allones = jnp.ones(best.shape[1:], bool)
+        for t in range(self.n_streams):
+            allones = allones & (best[t] == U32(0xFFFFFFFF))
+        return best.at[self.n_streams - 1].set(
+            jnp.where(allones, U32(0xFFFFFFFE),
+                      best[self.n_streams - 1]))
+
+    # ---- the three engine entry points (raft-interface twins) ----------
+
+    def fingerprint(self, sv: Dict) -> jnp.ndarray:
+        return self._core(sv, nb=0)
+
+    def fingerprint_batch(self, svb: Dict) -> jnp.ndarray:
+        svT = {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()}
+        return self._core(svT, nb=1).T                 # [B, n_streams]
+
+    def fingerprint_batch_T(self, svT: Dict) -> jnp.ndarray:
+        return self._core(svT, nb=1)

@@ -1,0 +1,128 @@
+"""Paxos ``SpecIR`` assembly — the whole operator surface in one place.
+
+Families enumerate in the oracle's order (model.successors): Phase1a,
+Phase1b, Phase2a, Phase2b, instance-major within each family.  Every
+family declares its guard algebra — each guard is exactly ONE feature
+of kernels.guard_features (set-ness makes Paxos guards single-feature
+thresholds; the interesting logic lives in the feature computation),
+so the int8 guard matmul is a permutation-selection matrix here and
+bit-exactness vs the lane sweep is immediate.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import SpecIR
+
+
+# Enabled-lane density (buffer sizing; overflow grows + replays).  A
+# fresh Paxos state enables one Phase1a per unsent ballot and fans 1b/2b
+# out per acceptor; small lane grids make generous caps cheap.
+FAMILY_DENSITY = {
+    "Phase1a": 4, "Phase1b": 8, "Phase2a": 4, "Phase2b": 8,
+}
+
+
+def build_families(lay) -> List["Family"]:
+    from ...engine.expand import Family
+    from .kernels import PaxosKernels
+    kern = PaxosKernels(lay)
+    I, N, B, V = lay.I, lay.N, lay.B, lay.V
+
+    def grid(*ranges):
+        arrs = np.meshgrid(*[np.asarray(r, np.int32) for r in ranges],
+                           indexing="ij")
+        return tuple(a.ravel() for a in arrs)
+
+    return [
+        Family("Phase1a", kern.phase1a, grid(range(I), range(B)),
+               lambda i, b: f"Phase1a({i},{b})",
+               guard=lambda off, lay, i, b: (
+                   [(off["p1a"] + i * lay.B + b, 1)], 1)),
+        Family("Phase1b", kern.phase1b,
+               grid(range(I), range(N), range(B)),
+               lambda i, a, b: f"Phase1b({i},{a},{b})",
+               guard=lambda off, lay, i, a, b: (
+                   [(off["p1b"] + (i * lay.N + a) * lay.B + b, 1)], 1)),
+        Family("Phase2a", kern.phase2a,
+               grid(range(I), range(B), range(V)),
+               lambda i, b, v: f"Phase2a({i},{b},{v})",
+               guard=lambda off, lay, i, b, v: (
+                   [(off["p2a"] + (i * lay.B + b) * lay.V + v, 1)], 1)),
+        Family("Phase2b", kern.phase2b,
+               grid(range(I), range(N), range(B), range(V)),
+               lambda i, a, b, v: f"Phase2b({i},{a},{b},{v})",
+               guard=lambda off, lay, i, a, b, v: (
+                   [(off["p2b"] +
+                     ((i * lay.N + a) * lay.B + b) * lay.V + v, 1)],
+                   1)),
+    ]
+
+
+def sim_progress(kern, lay):
+    """Punctuated-restart ladder for the sim engine: proposal seen <
+    acceptance seen < value chosen (the paxos phase ladder)."""
+    import jax
+    import jax.numpy as jnp
+
+    def score(svT):
+        derT = jax.vmap(kern.derived, in_axes=-1, out_axes=-1)(svT)
+        any2a = jnp.any(derT["b2a"] > 0, axis=(0, 1, 2))
+        any2b = jnp.any(derT["b2b"] > 0, axis=(0, 1, 2, 3))
+        chose = jnp.any(derT["chosen"], axis=(0, 1))
+        return (any2a.astype(jnp.int32) +
+                2 * any2b.astype(jnp.int32) +
+                4 * chose.astype(jnp.int32))
+
+    return score
+
+
+def build_ir() -> SpecIR:
+    from . import layout as codec
+    from .config import PaxosConfig
+    from .kernels import PaxosKernels
+    from .layout import PaxosLayout
+    from .model import (GLOB_DEPENDENT, INVARIANTS, init_state,
+                        state_from_obj, state_to_obj, successors,
+                        symmetry_perms, walk_key)
+    from .oracle import explore
+    from .vpredicates import (PaxosPredicates, SCENARIO_PROPERTIES)
+
+    def make_fingerprinter(cfg):
+        from .fingerprint import PaxosFingerprinter
+        return PaxosFingerprinter(cfg)
+
+    return SpecIR(
+        name="paxos",
+        version=1,
+        make_layout=PaxosLayout,
+        init_state=init_state,
+        encode=codec.encode,
+        decode=codec.decode,
+        narrow=codec.narrow,
+        widen=codec.widen,
+        view_keys=codec.VIEW_KEYS,
+        nonview_keys=codec.NONVIEW_KEYS,
+        state_to_obj=state_to_obj,
+        state_from_obj=state_from_obj,
+        make_kernels=PaxosKernels,
+        build_families=build_families,
+        family_density=dict(FAMILY_DENSITY),
+        make_predicates=PaxosPredicates,
+        scenario_properties=SCENARIO_PROPERTIES,
+        known_invariants=frozenset(INVARIANTS),
+        known_constraints=frozenset(),
+        known_action_constraints=frozenset(),
+        glob_dependent=GLOB_DEPENDENT,
+        make_fingerprinter=make_fingerprinter,
+        symmetry_perms=symmetry_perms,
+        oracle_explore=explore,
+        oracle_successors=successors,
+        oracle_walk_key=walk_key,
+        prefix_pin_seeds=None,
+        sim_progress=sim_progress,
+        default_config=PaxosConfig,
+    )

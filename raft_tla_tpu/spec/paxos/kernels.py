@@ -1,0 +1,179 @@
+"""Vectorizable Paxos action kernels: the Next-relation as pure jnp.
+
+Same contract as ops/kernels.RaftKernels: each kernel maps a *single*
+SoA state (layout.py) plus static-shaped lane parameters to
+``(ok, state')`` — ``ok`` is the enabling guard, the returned state is
+garbage when False and the engine masks it.  The engines vmap kernels
+over the frontier axis and parameter grids; semantics source of truth
+is ``model.py`` (the oracle), pinned by differential tests.
+
+Because ``msgs`` is a bitmask over a finite universe (layout.py), the
+whole action system is branch-free by construction: guards are bit
+tests + scalar compares, effects are bit ORs + [i, a] cell updates.
+The one non-trivial guard — Phase2a's ∃-quorum value rule — runs once
+per state in ``derived`` (a static python loop over the quorum list,
+each iteration pure jnp reductions), exactly mirroring the oracle's
+union-over-quorums form.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import C_GLOBLEN
+from .layout import PaxosLayout
+
+State = Dict[str, jnp.ndarray]
+
+U32 = jnp.uint32
+
+
+class PaxosKernels:
+    """Kernel family bound to one (PaxosLayout, PaxosConfig)."""
+
+    def __init__(self, lay: PaxosLayout):
+        self.lay = lay
+        self.cfg = lay.cfg
+        self.N, self.B, self.V, self.I = lay.N, lay.B, lay.V, lay.I
+
+    # ------------------------------------------------------------------
+    # bitmask helpers (single state; engines vmap around these)
+    # ------------------------------------------------------------------
+
+    def unpack_bits(self, words) -> jnp.ndarray:
+        """u32[MW] -> int32[n_msg_bits] 0/1 vector."""
+        j = np.arange(self.lay.n_msg_bits)
+        sh = jnp.asarray((j & 31).astype(np.uint32))
+        return ((words[j >> 5] >> sh) & U32(1)).astype(jnp.int32)
+
+    def _bit(self, words, idx):
+        """One (possibly traced) bit index -> 0/1 int32."""
+        sh = (idx & 31).astype(jnp.uint32)
+        return ((words[idx >> 5] >> sh) & U32(1)).astype(jnp.int32)
+
+    def _send(self, sv: State, idx) -> State:
+        """Monotone set add: OR the message's bit."""
+        w = idx >> 5
+        mask = U32(1) << (idx & 31).astype(jnp.uint32)
+        words = sv["msgs"]
+        return dict(sv, msgs=words.at[w].set(words[w] | mask))
+
+    def _glob(self, sv: State) -> State:
+        return dict(sv, ctr=sv["ctr"].at[C_GLOBLEN].add(1))
+
+    # ------------------------------------------------------------------
+    # Derived per-state quantities (recomputed once per expansion)
+    # ------------------------------------------------------------------
+
+    def derived(self, sv: State) -> State:
+        lay = self.lay
+        I, N, B, V = self.I, self.N, self.B, self.V
+        bits = self.unpack_bits(sv["msgs"])
+        b1a = bits[lay.off_1a:lay.off_1b].reshape(I, B)
+        b1b = bits[lay.off_1b:lay.off_2a].reshape(I, N, B, B + 1, V + 1)
+        b2a = bits[lay.off_2a:lay.off_2b].reshape(I, B, V)
+        b2b = bits[lay.off_2b:].reshape(I, N, B, V)
+        no2a = jnp.sum(b2a, axis=2) == 0                    # [I, B]
+        # chosen(i, v): ∃b with a 2b majority (quorums ARE the
+        # majorities, so existence is a counting test here — unlike
+        # Phase2a's value rule below, which couples to the quorum)
+        cnt = jnp.sum(b2b, axis=1)                          # [I, B, V]
+        chosen = jnp.any(2 * cnt > N, axis=1)               # [I, V]
+        # Phase2a value rule per (i, b, v): union over the static
+        # quorum list of the spec's ∃Q conjunct (model._p2a_value_ok)
+        bal = np.arange(B)
+        p2a = jnp.zeros((I, B, V), bool)
+        for Q in self.cfg.quorums:
+            qb = b1b[:, list(Q)]             # [I, |Q|, B, B+1, V+1]
+            have = jnp.all(jnp.sum(qb, axis=(3, 4)) > 0, axis=1)
+            pres = jnp.sum(qb, axis=1)       # [I, B, B+1, V+1]
+            voted = pres[:, :, 1:, :]        # mbal >= 0   [I, B, B, V+1]
+            any_voted = jnp.sum(voted, axis=(2, 3)) > 0     # [I, B]
+            mb_any = jnp.sum(voted, axis=3) > 0             # [I, B, Bm]
+            mx = jnp.max(jnp.where(mb_any, bal[None, None, :], -1),
+                         axis=2)                            # [I, B]
+            vmatch = voted[:, :, :, 1:] > 0  # real mvals [I, B, Bm, V]
+            at_max = vmatch & (bal[None, None, :, None] ==
+                               mx[:, :, None, None])
+            has_v = jnp.any(at_max, axis=2)                 # [I, B, V]
+            okq = have[:, :, None] & jnp.where(
+                any_voted[:, :, None], has_v, True)
+            p2a = p2a | okq
+        return {"bits": bits, "b1a": b1a, "b2a": b2a, "b1b": b1b,
+                "b2b": b2b, "no2a": no2a, "p2a": p2a, "chosen": chosen}
+
+    # ------------------------------------------------------------------
+    # Guard features (the int8 guard-matmul surface; offsets below)
+    # ------------------------------------------------------------------
+
+    def guard_features(self, sv: State, der: State) -> jnp.ndarray:
+        I, N, B, V = self.I, self.N, self.B, self.V
+        bal = jnp.arange(B)
+        f1a = 1 - der["b1a"]                                 # [I, B]
+        f1b = (der["b1a"][:, None, :] > 0) & \
+            (bal[None, None, :] > sv["mb"][:, :, None])      # [I, N, B]
+        f2a = der["no2a"][:, :, None] & der["p2a"]           # [I, B, V]
+        f2b = (der["b2a"][:, None] > 0) & \
+            (bal[None, None, :, None] >=
+             sv["mb"][:, :, None, None])                     # [I, N, B, V]
+        return jnp.concatenate([
+            f1a.reshape(-1), f1b.reshape(-1).astype(jnp.int32),
+            f2a.reshape(-1).astype(jnp.int32),
+            f2b.reshape(-1).astype(jnp.int32)]).astype(jnp.int8)
+
+    def guard_feature_offsets(self) -> Dict[str, int]:
+        I, N, B, V = self.I, self.N, self.B, self.V
+        off = dict(p1a=0, p1b=I * B, p2a=I * B + I * N * B)
+        off["p2b"] = off["p2a"] + I * B * V
+        off["total"] = off["p2b"] + I * N * B * V
+        return off
+
+    # ------------------------------------------------------------------
+    # Action kernels (oracle twins in model.py, cited per kernel)
+    # ------------------------------------------------------------------
+
+    def phase1a(self, sv: State, der: State, i, b) \
+            -> Tuple[jnp.ndarray, State]:
+        """model.phase1a: start (or preempt with) ballot b; novelty-
+        guarded — a re-send is the identity transition."""
+        idx = self.lay.off_1a + i * self.B + b
+        ok = self._bit(sv["msgs"], idx) == 0
+        return ok, self._glob(self._send(sv, idx))
+
+    def phase1b(self, sv: State, der: State, i, a, b) \
+            -> Tuple[jnp.ndarray, State]:
+        """model.phase1b: promise b, reporting the accepted pair."""
+        B, V, N = self.B, self.V, self.N
+        ok = (self._bit(sv["msgs"], self.lay.off_1a + i * B + b) == 1) \
+            & (b > sv["mb"][i, a])
+        mbal = sv["vb"][i, a]
+        mval = sv["vv"][i, a]
+        idx = self.lay.off_1b + \
+            (((i * N + a) * B + b) * (B + 1) + (mbal + 1)) * (V + 1) \
+            + (mval + 1)
+        sv2 = dict(sv, mb=sv["mb"].at[i, a].set(b))
+        return ok, self._glob(self._send(sv2, idx))
+
+    def phase2a(self, sv: State, der: State, i, b, v) \
+            -> Tuple[jnp.ndarray, State]:
+        """model.phase2a: propose v at b (∃-quorum rule in derived)."""
+        ok = der["no2a"][i, b] & der["p2a"][i, b, v]
+        idx = self.lay.off_2a + (i * self.B + b) * self.V + v
+        return ok, self._glob(self._send(sv, idx))
+
+    def phase2b(self, sv: State, der: State, i, a, b, v) \
+            -> Tuple[jnp.ndarray, State]:
+        """model.phase2b: accept (b, v)."""
+        B, V, N = self.B, self.V, self.N
+        idx2a = self.lay.off_2a + (i * B + b) * V + v
+        ok = (self._bit(sv["msgs"], idx2a) == 1) & \
+            (b >= sv["mb"][i, a])
+        sv2 = dict(sv,
+                   mb=sv["mb"].at[i, a].set(b),
+                   vb=sv["vb"].at[i, a].set(b),
+                   vv=sv["vv"].at[i, a].set(v))
+        idx = self.lay.off_2b + ((i * N + a) * B + b) * V + v
+        return ok, self._glob(self._send(sv2, idx))

@@ -1,0 +1,104 @@
+"""Vectorized Paxos invariants + scenario properties (device twins of
+model.py's oracle predicates, same (sv, der) -> holds contract as
+ops/vpredicates.Predicates).
+
+Quantifier structure becomes broadcasting over the unpacked message-bit
+blocks (derived carries them): "every 2b has its 2a" is one masked
+compare, Agreement's ∃-quorum "chosen" test is the majority counting
+closed form (quorums = majorities), computed once per state in
+``kernels.derived``.
+
+Paxos declares NO constraints and NO action constraints — the state
+space is finite without them (config.py docstring) — so those
+registries are empty and resolve loudly, naming the spec.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+from .kernels import PaxosKernels
+from .layout import PaxosLayout
+
+
+class PaxosPredicates:
+    """Predicate family bound to one (PaxosLayout, PaxosConfig)."""
+
+    def __init__(self, lay: PaxosLayout):
+        self.lay = lay
+        self.cfg = lay.cfg
+        self.kern = PaxosKernels(lay)
+
+    # ---- safety invariants (oracle twins in model.py) ------------------
+
+    def agreement(self, sv, der):
+        """model.agreement: ≤ 1 chosen value per instance."""
+        return jnp.all(jnp.sum(der["chosen"], axis=1) <= 1)
+
+    def validity(self, sv, der):
+        """model.validity: every 2b traces to its 2a; every 1b report
+        is consistent (mbal >= 0 iff mval >= 0) and traces to the 2a it
+        accepted."""
+        b1b, b2a, b2b = der["b1b"], der["b2a"], der["b2b"]
+        ok_2b = jnp.all((b2b <= b2a[:, None]))
+        incons = jnp.any(b1b[:, :, :, 1:, 0] > 0) | \
+            jnp.any(b1b[:, :, :, 0, 1:] > 0)
+        # real reports [(mbal, mval) >= 0], any acceptor/promise ballot
+        rep = jnp.any(b1b[:, :, :, 1:, 1:] > 0, axis=(1, 2))  # [I,Bm,V]
+        ok_1b = jnp.all(~rep | (b2a > 0))
+        return ok_2b & ~incons & ok_1b
+
+    def one_value_per_ballot(self, sv, der):
+        """model.one_value_per_ballot."""
+        return jnp.all(jnp.sum(der["b2a"], axis=2) <= 1)
+
+    # ---- scenario properties (negated reachability) --------------------
+
+    def value_chosen(self, sv, der):
+        return ~jnp.any(der["chosen"])
+
+    def two_ballots(self, sv, der):
+        started = jnp.any(der["b1a"] > 0, axis=0)          # [B]
+        return jnp.sum(started) < 2
+
+    def preempted(self, sv, der):
+        return ~jnp.any((sv["vb"] >= 0) & (sv["mb"] > sv["vb"]))
+
+    # ---- registries ----------------------------------------------------
+
+    def invariant_fn(self, name: str) -> Callable:
+        try:
+            return INVARIANTS[name].__get__(self)
+        except KeyError:
+            raise KeyError(
+                f"unknown invariant {name!r} for spec 'paxos'; known: "
+                f"{', '.join(sorted(INVARIANTS))}") from None
+
+    def constraint_fn(self, name: str) -> Callable:
+        raise KeyError(
+            f"unknown constraint {name!r} for spec 'paxos' — paxos "
+            "declares no search constraints (the bounded space is "
+            "finite without them)")
+
+    def action_fn(self, name: str) -> Callable:
+        raise KeyError(
+            f"unknown action constraint {name!r} for spec 'paxos' — "
+            "paxos declares none")
+
+
+INVARIANTS: Dict[str, Callable] = {
+    "Agreement": PaxosPredicates.agreement,
+    "Validity": PaxosPredicates.validity,
+    "OneValuePerBallot": PaxosPredicates.one_value_per_ballot,
+    "ValueChosen": PaxosPredicates.value_chosen,
+    "TwoBallots": PaxosPredicates.two_ballots,
+    "Preempted": PaxosPredicates.preempted,
+}
+
+SCENARIO_PROPERTIES = ("ValueChosen", "TwoBallots", "Preempted")
+
+for _nm in SCENARIO_PROPERTIES:
+    assert _nm in INVARIANTS, \
+        f"scenario property {_nm!r} has no device predicate"

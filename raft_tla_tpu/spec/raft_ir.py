@@ -1,0 +1,232 @@
+"""The Raft spec as the first ``SpecIR`` instance.
+
+This is a *re-homing*, not a rewrite: the model oracle
+(``models/raft.py``), packed layout/codec (``ops/layout.py`` /
+``ops/codec.py``), kernels (``ops/kernels.py``), device predicates
+(``ops/vpredicates.py``), symmetry fingerprinter
+(``engine/fingerprint.RaftFingerprinter``) and oracle explorer
+(``models/explore.py``) all stay where they are — this module only
+assembles them into the operator surface the engines consume, and owns
+the two tables that used to be hard-wired into ``engine/expand.py``:
+
+  * the action-family registry (``build_families``) — each family now
+    carries its guard-algebra declaration (the signed-weight/threshold
+    row of the PR-8 int8 guard matmul) instead of the old if/elif chain
+    inside ``Expander._build_guard_matrix``; a new family without a
+    declaration fails at Expander construction naming THIS spec;
+  * the per-family enabled-lane density table (``FAMILY_DENSITY``) —
+    the raft-measured buffer-sizing caps ``--fam-cap-density``
+    overrides, now namespaced per spec.
+
+All existing Raft differential tests pin this assembly bit-exactly:
+lane order, guard weights and densities are byte-identical to the
+pre-IR ``engine/expand.py`` tables.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..config import (NEXT_ASYNC_CRASH, NEXT_DYNAMIC, NEXT_FULL)
+from . import SpecIR
+
+
+# ---------------------------------------------------------------------------
+# Action families (moved verbatim from engine/expand.build_families),
+# now with per-family guard-algebra declarations: each ``guard`` maps
+# (feature-offset table, layout, *lane params) -> ([(index, weight)],
+# threshold) over ops/kernels.guard_features — the exact rows the old
+# Expander._build_guard_matrix if/elif chain produced.
+# ---------------------------------------------------------------------------
+
+def build_families(lay) -> List["Family"]:
+    from ..engine.expand import Family
+    from ..ops.kernels import RaftKernels
+    cfg = lay.cfg
+    kern = RaftKernels(lay)
+    S, K = lay.S, lay.K
+    fams: List[Family] = []
+
+    def grid(*ranges):
+        arrs = np.meshgrid(*[np.asarray(r, np.int32) for r in ranges],
+                           indexing="ij")
+        return tuple(a.ravel() for a in arrs)
+
+    ij = grid(range(S), range(S))
+    ij_ne = tuple(a[ij[0] != ij[1]] for a in ij)        # i != j lanes
+    iv = grid(range(S), list(cfg.values))
+    i_ = grid(range(S))
+    k_ = grid(range(K))
+
+    fams.append(Family(
+        "RequestVote", kern.request_vote, ij,
+        lambda i, j: f"RequestVote({i},{j})",
+        guard=lambda off, lay, i, j: (
+            [(off["cand"] + i, 1), (off["needvote"] + i * lay.S + j, 1)],
+            2)))
+    fams.append(Family(
+        "BecomeLeader", kern.become_leader, i_,
+        lambda i: f"BecomeLeader({i})",
+        guard=lambda off, lay, i: (
+            [(off["cand"] + i, 1), (off["blq"] + i, 1)], 2)))
+    fams.append(Family(
+        "ClientRequest", kern.client_request, iv,
+        lambda i, v: f"ClientRequest({i},{v})",
+        guard=lambda off, lay, i, v: ([(off["leader"] + i, 1)], 1)))
+    fams.append(Family(
+        "AdvanceCommitIndex", kern.advance_commit_index, i_,
+        lambda i: f"AdvanceCommitIndex({i})",
+        guard=lambda off, lay, i: ([(off["leader"] + i, 1)], 1)))
+    fams.append(Family(
+        "AppendEntries", kern.append_entries, ij_ne,
+        lambda i, j: f"AppendEntries({i},{j})",
+        guard=lambda off, lay, i, j: (
+            [(off["leader"] + i, 1), (off["cfg"] + i * lay.S + j, 1)],
+            2)))
+    fams.append(Family(
+        "UpdateTerm", kern.update_term, k_,
+        lambda k: f"UpdateTerm[slot{k}]",
+        guard=lambda off, lay, k: ([(off["ut"] + k, 1)], 1)))
+    fams.append(Family(
+        "CocDiscard", kern.coc_discard, k_,
+        lambda k: f"CocDiscard[slot{k}]",
+        guard=lambda off, lay, k: ([(off["cocd"] + k, 1)], 1)))
+    fams.append(Family(
+        "Receive", kern.receive_main, k_,
+        lambda k: f"Receive[slot{k}]",
+        guard=lambda off, lay, k: ([(off["recv"] + k, 1)], 1)))
+    fams.append(Family(
+        "Timeout", kern.timeout, i_,
+        lambda i: f"Timeout({i})",
+        guard=lambda off, lay, i: (
+            [(off["folc"] + i, 1), (off["cfg"] + i * lay.S + i, 1)], 2)))
+    if cfg.next_family in (NEXT_ASYNC_CRASH, NEXT_FULL, NEXT_DYNAMIC):
+        fams.append(Family(
+            "Restart", lambda sv, der, i: kern.restart(sv, i), i_,
+            lambda i: f"Restart({i})",
+            guard=lambda off, lay, i: ([], 0)))   # unconditional
+    if cfg.next_family in (NEXT_FULL, NEXT_DYNAMIC):
+        fams.append(Family(
+            "Duplicate", lambda sv, der, k: kern.duplicate_message(sv, k),
+            k_, lambda k: f"Duplicate[slot{k}]",
+            guard=lambda off, lay, k: ([(off["cnt1"] + k, 1)], 1)))
+        fams.append(Family(
+            "Drop", lambda sv, der, k: kern.drop_message(sv, k),
+            k_, lambda k: f"Drop[slot{k}]",
+            guard=lambda off, lay, k: ([(off["cnt1"] + k, 1)], 1)))
+    if cfg.next_family == NEXT_DYNAMIC:
+        fams.append(Family(
+            "AddNewServer", kern.add_new_server, ij,
+            lambda i, j: f"AddNewServer({i},{j})",
+            # j ∉ config enters with weight -1 and no threshold share
+            guard=lambda off, lay, i, j: (
+                [(off["leader"] + i, 1),
+                 (off["cfg"] + i * lay.S + j, -1)], 1)))
+        fams.append(Family(
+            "DeleteServer", kern.delete_server, ij_ne,
+            lambda i, j: f"DeleteServer({i},{j})",
+            guard=lambda off, lay, i, j: (
+                [(off["leader"] + i, 1), (off["folc"] + j, 1),
+                 (off["cfg"] + i * lay.S + j, 1)], 3)))
+    return fams
+
+
+# Expected enabled-lane density per parent state, by family (measured
+# on the BASELINE configs; engine/expand sizes the per-family
+# materialization buffers from these — cap_f = chunk * min(lanes, d)).
+# Throughput tuning, not correctness bounds: overflow grows + replays.
+FAMILY_DENSITY = {
+    "Restart": 1 << 30, "Timeout": 1 << 30,
+    "RequestVote": 2, "BecomeLeader": 1, "ClientRequest": 2,
+    "AdvanceCommitIndex": 2, "AppendEntries": 2,
+    "UpdateTerm": 2, "CocDiscard": 1, "Receive": 4,
+    "Duplicate": 4, "Drop": 4, "AddNewServer": 2, "DeleteServer": 2,
+}
+
+
+# ---------------------------------------------------------------------------
+# The sim engine's punctuated-restart progress ladder (moved from
+# sim/walker._progress_T): leader elected < membership changes appended
+# < latest-ConfigEntry replication count at a current leader.
+# ---------------------------------------------------------------------------
+
+_SCORE_LEADER = 1 << 20
+_SCORE_NMC = 1 << 10
+
+
+def sim_progress(kern, lay):
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import LEADER
+    from ..ops.codec import C_NLEADERS, C_NMC
+
+    def score(svT):
+        derT = jax.vmap(kern.derived, in_axes=-1, out_axes=-1)(svT)
+        leader_seen = (svT["ctr"][C_NLEADERS] > 0).astype(jnp.int32)
+        nmc = svT["ctr"][C_NMC]
+        maxcfg = derT["maxcfg"]                       # [S, W]
+        repl = jnp.sum(svT["mi"] >= maxcfg[:, None, :],
+                       axis=1, dtype=jnp.int32)       # [S, W]
+        is_l = (svT["st"] == LEADER) & (maxcfg > 0)
+        repl = jnp.max(jnp.where(is_l, repl, 0), axis=0)
+        return leader_seen * _SCORE_LEADER + \
+            jnp.minimum(nmc, _SCORE_LEADER // _SCORE_NMC - 1) * \
+            _SCORE_NMC + jnp.minimum(repl, _SCORE_NMC - 1)
+
+    return score
+
+
+# ---------------------------------------------------------------------------
+# IR assembly
+# ---------------------------------------------------------------------------
+
+def build_ir() -> SpecIR:
+    from ..models import predicates as OP
+    from ..models.explore import (_walk_key, explore, symmetry_perms)
+    from ..models.golden import prefix_pin_seeds
+    from ..models.raft import (init_state, state_from_obj, state_to_obj,
+                               successors)
+    from ..ops import codec
+    from ..ops.layout import Layout
+    from ..ops.kernels import RaftKernels
+    from ..ops.vpredicates import (CONSTRAINTS as VC, INVARIANTS as VI,
+                                   Predicates, SCENARIO_PROPERTIES)
+
+    def make_fingerprinter(cfg):
+        from ..engine.fingerprint import RaftFingerprinter
+        return RaftFingerprinter(cfg)
+
+    return SpecIR(
+        name="raft",
+        version=1,
+        make_layout=Layout,
+        init_state=init_state,
+        encode=codec.encode,
+        decode=codec.decode,
+        narrow=codec.narrow,
+        widen=codec.widen,
+        view_keys=codec.VIEW_KEYS,
+        nonview_keys=codec.NONVIEW_KEYS,
+        state_to_obj=state_to_obj,
+        state_from_obj=state_from_obj,
+        make_kernels=RaftKernels,
+        build_families=build_families,
+        family_density=dict(FAMILY_DENSITY),
+        make_predicates=Predicates,
+        scenario_properties=SCENARIO_PROPERTIES,
+        known_invariants=frozenset(VI) | frozenset(OP.INVARIANTS),
+        known_constraints=frozenset(VC) | frozenset(OP.CONSTRAINTS),
+        known_action_constraints=frozenset(OP.ACTION_CONSTRAINTS),
+        glob_dependent=frozenset(OP.GLOB_DEPENDENT),
+        make_fingerprinter=make_fingerprinter,
+        symmetry_perms=symmetry_perms,
+        oracle_explore=explore,
+        oracle_successors=successors,
+        oracle_walk_key=_walk_key,
+        prefix_pin_seeds=prefix_pin_seeds,
+        sim_progress=sim_progress,
+        default_config=None,
+    )

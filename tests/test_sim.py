@@ -94,14 +94,23 @@ def test_repo_local_cfg_parses_like_reference():
 @pytest.mark.smoke
 def test_cli_target_validation_uses_registry(capsys):
     """trace/simulate --target validation and its error text come from
-    the shared registry, not a hand-kept string."""
+    the active spec's registry (SpecIR.scenario_properties), not a
+    hand-kept string."""
     from raft_tla_tpu.cli import _check_target
-    assert _check_target("MembershipChangeCommits")
-    assert _check_target("ElectionSafety")   # safety hunts stay legal
-    assert not _check_target("NoSuchScenario")
+    from raft_tla_tpu.spec import get_spec
+    raft = get_spec("raft")
+    assert _check_target("MembershipChangeCommits", raft)
+    assert _check_target("ElectionSafety", raft)   # safety hunts legal
+    assert not _check_target("NoSuchScenario", raft)
     err = capsys.readouterr().err
     assert "MembershipChangeCommits" in err
     assert "LeaderChangesDuringConfChange" in err
+    # per-spec: the same unknown name errors with the paxos registry
+    paxos = get_spec("paxos")
+    assert _check_target("ValueChosen", paxos)
+    assert not _check_target("MembershipChangeCommits", paxos)
+    err = capsys.readouterr().err
+    assert "spec 'paxos'" in err and "ValueChosen" in err
 
 
 @pytest.mark.smoke
@@ -232,8 +241,9 @@ def test_sim_seed_feeds_punctuated_check(member_hit, tmp_path):
     seed_file.write_text(json.dumps(obj))
 
     from raft_tla_tpu.cli import _engine_seed_arrays, _load_seeds
-    _oracle_seeds, raw = _load_seeds(str(seed_file))
-    seeds = _engine_seed_arrays(MEMBER, raw)
+    from raft_tla_tpu.spec import get_spec
+    _oracle_seeds, raw = _load_seeds(str(seed_file), get_spec("raft"))
+    seeds = _engine_seed_arrays(MEMBER, get_spec("raft"), raw)
     assert np.array_equal(seeds[0]["ctr"],
                           np.asarray(h.state_arrs["ctr"]))
     from raft_tla_tpu.engine.bfs import Engine

@@ -15,3 +15,8 @@ env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m smoke \
     -p no:cacheprovider "$@"
 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 env JAX_PLATFORMS=cpu python tools/guard_matmul_smoke.py
+# spec-agnostic frontend gate (round 10): one depth-capped
+# `check --spec paxos` pinned against the in-process oracle, plus the
+# engine-layer grep gate (engine/ and parallel/ must never import
+# models.raft directly — everything routes through the SpecIR handle)
+env JAX_PLATFORMS=cpu python tools/paxos_smoke.py

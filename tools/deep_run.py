@@ -9,7 +9,8 @@ The SpillEngine streams levels through host RAM (engine/spill), so its
 depth wall is the visited table (12 B/key fp64, 20 B/key fp128)
 instead of the level buffers.
 
-Usage: python tools/deep_run.py CONFIG DEPTH [--fp128] [--chunk N]
+Usage: python tools/deep_run.py CONFIG DEPTH [--spec raft|paxos]
+       [--fp128] [--chunk N]
        [--seg N] [--vcap N] [--tag NAME] [--classic] [--lcap N]
        [--fcap N] [--native] [--budget N] [--ckpt FILE]
        [--resume FILE] [--ckpt-every N] [--host-table]
@@ -31,6 +32,11 @@ depth wall becomes host RAM instead of the ~2^29-slot HBM table.
 Checkpoints then carry the partition images (sparse, exact-image
 restore) and --resume must repeat the same --host-table/--partitions;
 the engine refuses a mismatched resume rather than drift.
+
+--spec paxos runs the Paxos frontend instead of Raft: CONFIG then
+selects a ladder of Paxos models (1 = N3/B2/V2/I1 stock, 2 = N3/B3/V2,
+3 = N5/B2/V2, 4 = N3/B2/V2/I2) and --native is unavailable (the native
+C++ checker is Raft-only).
 
 --classic uses the in-HBM Engine instead of SpillEngine (for
 depth-exact head-to-heads at depths that still fit); --native also
@@ -85,7 +91,8 @@ def main():
              "--fcap", "--ckpt", "--resume", "--ckpt-every",
              "--partitions", "--part-cap", "--burst-levels",
              "--ledger", "--heartbeat", "--trace-timeline",
-             "--profile-dir", "--dedup-kernel", "--fam-cap-density"}
+             "--profile-dir", "--dedup-kernel", "--fam-cap-density",
+             "--spec"}
     bad = set(opts) - known
     if bad or len(args) % 2:
         # fail loud: these depths cannot be cross-checked by any other
@@ -112,21 +119,42 @@ def main():
     if dedup_kernel not in ("auto", "on", "off"):
         raise SystemExit(f"--dedup-kernel must be auto|on|off "
                          f"(got {dedup_kernel})")
+    spec = opts.get("--spec", "raft")
+    if spec not in ("raft", "paxos"):
+        raise SystemExit(f"--spec must be raft|paxos (got {spec})")
     fam_density = None
     if "--fam-cap-density" in opts:
         from raft_tla_tpu.engine.expand import parse_fam_density
+        from raft_tla_tpu.spec import get_spec
         try:
-            fam_density = parse_fam_density(opts["--fam-cap-density"])
+            fam_density = parse_fam_density(opts["--fam-cap-density"],
+                                            get_spec(spec))
         except ValueError as e:
             raise SystemExit(f"--fam-cap-density: {e}") from None
     mxu_kw = dict(guard_matmul=guard_matmul, dedup_kernel=dedup_kernel,
                   fam_density=fam_density)
     tag = opts.get("--tag",
-                   f"config{conf_no}_depth{depth}"
+                   ("paxos_" if spec == "paxos" else "")
+                   + f"config{conf_no}_depth{depth}"
                    + ("_fp128" if fp128 else "")
                    + ("_hosttable" if host_table else ""))
 
-    cfg = build_cfg(conf_no)
+    if spec == "paxos":
+        from raft_tla_tpu.spec.paxos.config import PaxosConfig
+        ladder = {1: PaxosConfig(),
+                  2: PaxosConfig(n_ballots=3),
+                  3: PaxosConfig(n_servers=5),
+                  4: PaxosConfig(n_instances=2)}
+        if conf_no not in ladder:
+            raise SystemExit(
+                f"--spec paxos CONFIG must be one of "
+                f"{sorted(ladder)} (got {conf_no})")
+        if flags["--native"]:
+            raise SystemExit("--native is raft-only (the native C++ "
+                             "checker has no Paxos frontend)")
+        cfg = ladder[conf_no]
+    else:
+        cfg = build_cfg(conf_no)
     if fp128:
         cfg = cfg.with_(fp128=True)
     nat_rec = None
@@ -157,7 +185,9 @@ def main():
     obs = from_flags(ledger=opts.get("--ledger"),
                      heartbeat=opts.get("--heartbeat"),
                      timeline=opts.get("--trace-timeline"),
-                     profile_dir=opts.get("--profile-dir"))
+                     profile_dir=opts.get("--profile-dir"),
+                     meta={"spec": eng.ir.name,
+                           "ir_fingerprint": eng.ir.fingerprint()})
     obs.start()
     t0 = time.perf_counter()
     with obs.span("compile"):
@@ -189,6 +219,8 @@ def main():
     obs.finish(depth=int(r.depth), states=int(r.distinct_states))
     rec = {
         "engine": type(eng).__name__,
+        "spec": eng.ir.name,
+        "ir_fingerprint": eng.ir.fingerprint(),
         "config": conf_no, "max_depth": depth,
         "fp_bits": 128 if fp128 else 64,
         "distinct": int(r.distinct_states), "depth": int(r.depth),
@@ -230,7 +262,8 @@ def main():
         rec["host_table_bytes"] = int(eng.hpt.nbytes)
     # (host-table runs are rate-recorded but never floor-gate: the
     # canonical spill probe guards the default in-HBM-table path)
-    if (not flags["--classic"] and conf_no == 2 and depth == 19
+    if (spec == "raft" and not flags["--classic"] and conf_no == 2
+            and depth == 19
             and rec["depth_exact"] and not fp128 and not resume
             and not host_table):
         import jax

@@ -1,0 +1,86 @@
+"""Paxos-frontend CI smoke (tools/ci_smoke.sh step, round 10).
+
+One depth-capped CLI check of the stock Paxos model (``--spec paxos``,
+reference-less, CPU) pinned against the plain-Python oracle computed
+in-process: distinct / generated / depth / violations must match
+bit-for-bit, the stats must stamp the spec name + IR fingerprint, and
+the engine-layer import gate must hold (``raft_tla_tpu/engine`` and
+``raft_tla_tpu/parallel`` never import ``models.raft`` directly — the
+grep-gate satellite of the SpecIR refactor, enforced here so a
+regression fails CI before any engine change lands).
+
+Exits 0 on identity, 1 with a message on any divergence.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEPTH = 8
+
+
+def fail(msg):
+    print(f"paxos_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def import_gate():
+    """Spec-agnostic engine layer: no direct models.raft imports."""
+    pat = re.compile(r"models\s*\.\s*raft|models\s+import\s+raft|"
+                     r"models\.raft\s+import")
+    bad = []
+    for sub in ("engine", "parallel", "sim"):
+        root = os.path.join(_REPO, "raft_tla_tpu", sub)
+        for dirp, _dirs, files in os.walk(root):
+            for f in files:
+                if not f.endswith(".py"):
+                    continue
+                path = os.path.join(dirp, f)
+                for ln, line in enumerate(open(path), 1):
+                    if pat.search(line):
+                        bad.append(f"{path}:{ln}: {line.strip()}")
+    if bad:
+        fail("engine layer imports models.raft directly again "
+             "(route through the SpecIR handle):\n" + "\n".join(bad))
+
+
+def main():
+    import_gate()
+    td = tempfile.mkdtemp(prefix="paxos_smoke_")
+    stats_path = os.path.join(td, "paxos.json")
+    cmd = [sys.executable, "-m", "raft_tla_tpu", "check",
+           "--spec", "paxos", "--max-depth", str(DEPTH),
+           "--chunk", "128", "--no-store",
+           "--stats-json", stats_path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, env=env, cwd=_REPO,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        fail(f"check --spec paxos failed rc={proc.returncode}:\n"
+             f"{proc.stderr}")
+    got = json.load(open(stats_path))
+    if got.get("spec") != "paxos" or not got.get("ir_fingerprint"):
+        fail(f"stats not spec-stamped: spec={got.get('spec')!r} "
+             f"ir_fingerprint={got.get('ir_fingerprint')!r}")
+    from raft_tla_tpu.spec.paxos.config import PaxosConfig
+    from raft_tla_tpu.spec.paxos.oracle import explore
+    ro = explore(PaxosConfig(), max_depth=DEPTH)
+    for key, want in (("distinct_states", ro.distinct_states),
+                      ("generated_states", ro.generated_states),
+                      ("depth", ro.depth),
+                      ("violations", len(ro.violations))):
+        if got[key] != want:
+            fail(f"{key}: engine {got[key]} != oracle {want}")
+    print(f"paxos_smoke: ok — engine ≡ oracle at depth {DEPTH} "
+          f"({got['distinct_states']} distinct, spec-stamped "
+          f"{got['ir_fingerprint']}), engine-layer import gate clean")
+
+
+if __name__ == "__main__":
+    main()

@@ -78,7 +78,19 @@ def warm_spill(tag, cfg, **kw):
 def main():
     from tools.measure_baseline import ENGINE_KW, build_cfg
 
-    args = [int(a) for a in sys.argv[1:]]
+    # per-spec warming (SpecIR frontends compile distinct programs):
+    # "paxos" warms the stock Paxos model's executables — both matmul
+    # and burst modes, plus a spill pass — alongside the raft ladder
+    raw = sys.argv[1:]
+    if "paxos" in raw:
+        raw = [a for a in raw if a != "paxos"]
+        from raft_tla_tpu.spec.paxos.config import PaxosConfig
+        pcfg = PaxosConfig()
+        warm("paxos default", pcfg, chunk=256)
+        warm_spill("paxos spill", pcfg, chunk=256, seg=1 << 14)
+        if not raw:
+            return
+    args = [int(a) for a in raw]
     # bench.py's shapes first: its micro correctness-gate engine
     # (chunk=256) AND its headline capacities both differ from
     # measure_baseline's budgeted ones — without them a post-prewarm
