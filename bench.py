@@ -326,6 +326,98 @@ def _matmul_ab(out_path):
     return out
 
 
+def _batch_ab(out_path):
+    """Multi-tenant batch A/B (BENCH round 10, ROADMAP 2b): K=4 small
+    jobs — the same micro config under four different depth gates, the
+    serving layer's bread-and-butter repeat-tenant shape — run
+    sequentially (one engine per job: K compiles, K dispatch chains)
+    vs batched (ONE bucket engine, ONE job-vmapped device program,
+    per-job state on a leading [J] axis).  Records compile count,
+    dispatch count and wall-clock per job for both modes under the
+    shared correctness gate: every per-job result must be identical
+    across modes or the file is labeled FAILED and the headline gate
+    trips.  On this CPU-only container the rows are an honest CPU
+    fallback (the compile/dispatch COUNTS are platform-independent;
+    the seconds are XLA:CPU), as in BENCH_r05-r09."""
+    import jax
+
+    from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+    from raft_tla_tpu.obs import Obs, SpanRecorder
+    from raft_tla_tpu.serve import Job, run_jobs
+
+    micro = ModelConfig(
+        n_servers=2, init_servers=(0, 1), values=(1,),
+        next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+        bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                           max_client_requests=1))
+    DEPTHS = (3, 4, 5, 6)
+    K = len(DEPTHS)
+
+    def mk_jobs():
+        return [Job(micro, max_depth=d, label=f"d{d}") for d in DEPTHS]
+
+    rows, per_job, raw_secs = {}, {}, {}
+    for label, seq in (("sequential", True), ("batched", False)):
+        rec = SpanRecorder()
+        t0 = time.perf_counter()
+        rep = run_jobs(mk_jobs(), obs=Obs(spans=rec), sequential=seq)
+        secs = raw_secs[label] = time.perf_counter() - t0
+        per_job[label] = {
+            o.job.label: (int(o.res.distinct_states),
+                          int(o.res.generated_states),
+                          int(o.res.depth),
+                          tuple(int(x) for x in o.res.level_sizes))
+            for o in rep.outcomes}
+        device_dispatches = sum(
+            int(o.res.burst_dispatches) +
+            (int(o.res.depth) - int(o.res.levels_fused))
+            for o in rep.outcomes) if seq else \
+            rep.meta["batch_dispatches"]
+        rows[label] = {
+            "jobs": K,
+            "engines_compiled": rep.meta["engines_compiled"],
+            "device_dispatches": int(device_dispatches),
+            "seconds": round(secs, 2),
+            "seconds_per_job": round(secs / K, 2),
+            "statuses": [o.status for o in rep.outcomes],
+            "phase_seconds": {nm: t["seconds"]
+                              for nm, t in rec.totals().items()},
+            "phase_counts": {nm: t["count"]
+                             for nm, t in rec.totals().items()},
+        }
+    identical = per_job["sequential"] == per_job["batched"]
+    all_batched = all(s == "done"
+                      for s in rows["batched"]["statuses"])
+    # raw timings, not the 2-decimal display rounding in the rows
+    speedup = raw_secs["sequential"] / max(raw_secs["batched"], 1e-9)
+    out = {
+        "bench": "multi-tenant batch A/B: K=4 small jobs sequential "
+                 "vs one job-vmapped device program (bench.py, "
+                 "BENCH_r10 round)",
+        "platform": jax.default_backend(),
+        "honest_label": (
+            "CPU-only fallback: this container has no TPU; the "
+            "compile/dispatch counts and result identities are "
+            "platform-independent, the seconds are XLA:CPU"
+            if jax.default_backend() == "cpu" else "TPU-measured"),
+        "status": ("ok" if identical and all_batched else
+                   "FAILED: batched per-job results diverge from the "
+                   "sequential engines (or jobs fell back) — the perf "
+                   "rows are meaningless"),
+        "results_identical": identical,
+        "all_jobs_batched": all_batched,
+        "per_job_speedup": round(speedup, 2),
+        "rows": rows,
+        "per_job_counts": {lbl: list(v) for lbl, v in
+                           per_job["batched"].items()},
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, out_path)
+    return out
+
+
 def _no_reference_fallback():
     """Containers without the reference checkout (and without the TPU)
     cannot run the headline metric at all — emit ONE honestly-labeled
@@ -391,6 +483,10 @@ def _no_reference_fallback():
     matmul_ab = _matmul_ab(os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "BENCH_r09.json"))
     gate_ok = gate_ok and matmul_ab["status"] == "ok"
+    # round 10: the multi-tenant batch A/B rides the same shared gate
+    batch_ab = _batch_ab(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r10.json"))
+    gate_ok = gate_ok and batch_ab["status"] == "ok"
     print(json.dumps({
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
         "value": None, "unit": "states/sec", "vs_baseline": None,
@@ -411,7 +507,14 @@ def _no_reference_fallback():
                        "status": matmul_ab["status"],
                        "states_per_sec": {
                            k: v["states_per_sec"]
-                           for k, v in matmul_ab["rows"].items()}}}}))
+                           for k, v in matmul_ab["rows"].items()}},
+                   "batch_ab": {
+                       "written_to": "BENCH_r10.json",
+                       "status": batch_ab["status"],
+                       "per_job_speedup": batch_ab["per_job_speedup"],
+                       "engines_compiled": {
+                           k: v["engines_compiled"]
+                           for k, v in batch_ab["rows"].items()}}}}))
 
 
 def main():
@@ -509,6 +612,9 @@ def main():
     matmul_ab = _matmul_ab(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_r09.json"))
     gate_ok = gate_ok and matmul_ab["status"] == "ok"
+    batch_ab = _batch_ab(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r10.json"))
+    gate_ok = gate_ok and batch_ab["status"] == "ok"
 
     # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------
     # Only meaningful for the full-depth run on the recorded machine
@@ -557,6 +663,7 @@ def main():
     out["detail"]["burst_ab_counts_identical"] = \
         bool(burst_ab["counts_identical"])
     out["detail"]["matmul_ab_status"] = matmul_ab["status"]
+    out["detail"]["batch_ab_status"] = batch_ab["status"]
     print(json.dumps(out))
 
 

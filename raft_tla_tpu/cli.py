@@ -120,11 +120,14 @@ def _apply_overrides(cfg, args):
 
 def _load_paxos_model(args):
     """--spec paxos config assembly: the cfg positional is optional
-    (None/"default" -> the stock small model; else a JSON file of
-    constants), then the generic CLI overrides apply (--servers =
-    acceptors, --ballots/--paxos-values/--instances, --symmetry,
-    --fp128, --invariant)."""
+    (None/"default" -> the stock small model; a ``.cfg`` path -> the
+    TLC CONSTANTS front-end, cfg/parser.load_paxos_model; anything
+    else -> a JSON file of constants), then the generic CLI overrides
+    apply (--servers = acceptors, --ballots/--paxos-values/
+    --instances, --symmetry, --fp128, --invariant)."""
     import json as _json
+    from .cfg.parser import (CfgError, load_paxos_model,
+                             paxos_config_from_obj)
     from .spec import get_spec
     from .spec.paxos.config import PaxosConfig
     raft_only = [flag for flag, attr in (
@@ -140,39 +143,19 @@ def _load_paxos_model(args):
             f"{', '.join(raft_only)} are raft-only bounds/toggles — "
             "spec 'paxos' is bounded by --ballots/--paxos-values/"
             "--instances/--servers instead")
-    kw = {}
     if args.cfg and args.cfg != "default":
-        with open(args.cfg) as fh:
-            raw = _json.load(fh)
-        alias = {"acceptors": "n_servers", "servers": "n_servers",
-                 "ballots": "n_ballots", "values": "n_values",
-                 "instances": "n_instances"}
-        for k, v in raw.items():
-            kk = alias.get(k, k)
-            if kk not in ("n_servers", "n_ballots", "n_values",
-                          "n_instances", "symmetry", "fp128",
-                          "invariants"):
-                raise SystemExit(
-                    f"{args.cfg}: unknown paxos config key {k!r}")
-            if kk in ("symmetry", "fp128"):
-                if not isinstance(v, bool):
-                    raise SystemExit(
-                        f"{args.cfg}: {k} must be a JSON bool "
-                        f"(got {v!r})")
-            elif kk == "invariants":
-                known = get_spec("paxos").known_invariants
-                bad = [nm for nm in v if nm not in known]
-                if bad:
-                    raise SystemExit(
-                        f"{args.cfg}: unknown invariant(s) "
-                        f"{', '.join(map(repr, bad))} for spec "
-                        f"'paxos'; known: {', '.join(sorted(known))}")
-                v = tuple(v)
-            elif isinstance(v, bool) or not isinstance(v, int):
-                raise SystemExit(
-                    f"{args.cfg}: {k} must be a JSON integer "
-                    f"(got {v!r})")
-            kw[kk] = v
+        try:
+            if args.cfg.endswith(".cfg"):
+                cfg = load_paxos_model(args.cfg)
+            else:
+                with open(args.cfg) as fh:
+                    raw = _json.load(fh)
+                cfg = paxos_config_from_obj(raw, where=args.cfg)
+        except CfgError as e:
+            raise SystemExit(str(e))
+    else:
+        cfg = PaxosConfig()
+    kw = {}
     if args.servers is not None:
         kw["n_servers"] = args.servers
     if getattr(args, "ballots", None) is not None:
@@ -186,7 +169,8 @@ def _load_paxos_model(args):
     if args.fp128:
         kw["fp128"] = True
     try:
-        cfg = PaxosConfig(**kw)
+        if kw:
+            cfg = cfg.with_(**kw)
     except ValueError as e:
         raise SystemExit(f"paxos config: {e}")
     if getattr(args, "invariants", None):
@@ -684,6 +668,68 @@ def cmd_simulate(args):
     return 0
 
 
+def cmd_batch(args):
+    """Multi-tenant batched checking (serve/): a job list from a JSONL
+    file and/or repeated --job flags, grouped into shape buckets and
+    run as one device program per bucket, with fingerprint-keyed
+    result caching.  Prints one summary JSON line, then one report
+    line per job (submission order).  Exit 0 = all clean, 1 = some job
+    found violations, 2 = usage error."""
+    from .cfg.parser import CfgError
+    from .serve import (ResultCache, job_from_dict, load_jobs,
+                        run_jobs)
+    jobs = []
+    if args.jobs:
+        try:
+            jobs.extend(load_jobs(args.jobs))
+        except (OSError, ValueError, CfgError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    for k, text in enumerate(args.job or []):
+        where = f"--job #{k + 1}"
+        try:
+            jobs.append(job_from_dict(json.loads(text), where=where))
+        except (OSError, ValueError) as e:
+            # OSError too: a missing config path is a usage error
+            # (exit 2), not a violation-style exit 1
+            msg = str(e) if str(e).startswith(where) \
+                else f"{where}: {e}"
+            print(msg, file=sys.stderr)
+            return 2
+    if not jobs:
+        print("no jobs: pass --jobs FILE.jsonl and/or --job JSON",
+              file=sys.stderr)
+        return 2
+    cache = ResultCache(args.cache_dir) if args.cache_dir else None
+    obs = _build_obs(args)
+    obs.start()
+    done = False
+    try:
+        rep = run_jobs(jobs, cache=cache, obs=obs,
+                       sequential=args.sequential,
+                       verbose=args.verbose)
+        done = True
+    finally:
+        if done:
+            obs.finish(
+                depth=max((int(o.report.get("depth", 0))
+                           for o in rep.outcomes), default=0),
+                states=sum(int(o.report.get("distinct_states", 0))
+                           for o in rep.outcomes))
+        else:
+            obs.finish(status="failed")
+    print(json.dumps(rep.summary))
+    for o in rep.outcomes:
+        print(json.dumps(o.report))
+    if args.stats_json:
+        with open(args.stats_json, "w") as fh:
+            json.dump({"summary": rep.summary,
+                       "jobs": [o.report for o in rep.outcomes]}, fh)
+    n_viol = sum(int(o.report.get("violations", 0))
+                 for o in rep.outcomes)
+    return 1 if n_viol else 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="raft_tla_tpu",
@@ -694,9 +740,9 @@ def main(argv=None):
     def common(sp):
         sp.add_argument("cfg", nargs="?", default=None,
                         help="model file: a TLC .cfg path (--spec "
-                             "raft; required) or a JSON constants "
-                             "file / 'default' (--spec paxos; "
-                             "optional)")
+                             "raft; required) or a TLC .cfg / JSON "
+                             "constants file / 'default' (--spec "
+                             "paxos; optional)")
         sp.add_argument("--spec", choices=("raft", "paxos"),
                         default="raft",
                         help="which spec frontend (SpecIR) to check: "
@@ -910,6 +956,36 @@ def main(argv=None):
                     help="write the run stats JSON to FILE")
     _add_obs_flags(ps)
     ps.set_defaults(fn=cmd_simulate)
+
+    pb = sub.add_parser(
+        "batch",
+        help="multi-tenant batched checking: many (spec, config) jobs "
+             "packed into one device program per shape bucket, with "
+             "fingerprint-keyed result caching (README 'Batch / "
+             "serving' documents the JSONL job format)")
+    pb.add_argument("--jobs", default=None, metavar="FILE",
+                    help="JSONL job file: one job object per line "
+                         "(blank lines and #-comments skipped)")
+    pb.add_argument("--job", action="append", default=None,
+                    metavar="JSON",
+                    help="inline job object (repeatable), same schema "
+                         "as a --jobs line")
+    pb.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="result cache: jobs whose (spec, config, "
+                         "engine-options) fingerprints match a cached "
+                         "result are answered with zero device "
+                         "dispatches; results persist across "
+                         "invocations")
+    pb.add_argument("--sequential", action="store_true",
+                    help="run each job on its own engine instead of "
+                         "the batched path (the honest A/B reference "
+                         "— N jobs pay N compiles)")
+    pb.add_argument("--stats-json", default=None, metavar="FILE",
+                    help="write the batch summary + per-job reports "
+                         "as one JSON file")
+    pb.add_argument("--verbose", "-v", action="store_true")
+    _add_obs_flags(pb)
+    pb.set_defaults(fn=cmd_batch)
 
     args = p.parse_args(argv)
     _honor_platform_env()

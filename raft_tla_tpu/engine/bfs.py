@@ -78,6 +78,32 @@ class CheckpointError(ValueError):
 
 _CACHE_ENABLED = False
 
+_BARRIER_BATCH_REGISTERED = False
+
+
+def _register_barrier_batching():
+    """``jax.vmap`` over the burst core (the job-axis batched burst the
+    serving layer runs) needs a batching rule for
+    ``lax.optimization_barrier``; this jax version ships none.  The
+    barrier is an identity, so the rule is dim-passthrough: bind the
+    batched operands unchanged.  Registered lazily — only when the
+    batched burst is actually used — and a no-op on jax versions that
+    grow the rule upstream."""
+    global _BARRIER_BATCH_REGISTERED
+    if _BARRIER_BATCH_REGISTERED:
+        return
+    _BARRIER_BATCH_REGISTERED = True
+    try:
+        from jax._src.lax import lax as _lax_internal
+        from jax.interpreters import batching as _batching
+        prim = _lax_internal.optimization_barrier_p
+    except (ImportError, AttributeError):
+        return
+    if prim not in _batching.primitive_batchers:
+        def _rule(args, dims):
+            return prim.bind(*args), dims
+        _batching.primitive_batchers[prim] = _rule
+
 
 def enable_persistent_compilation_cache():
     """Persist XLA executables across processes (TPU compiles of the
@@ -528,6 +554,9 @@ class Engine:
                              else self._BURST_LEVELS)
         self._burst_jit = jax.jit(self._burst_impl, donate_argnums=0,
                                   static_argnums=1)
+        # job-axis batched burst (serve/batch) — built lazily by
+        # burst_batched_fn, so solo checks never trace it
+        self._bat_jit = None
 
     def _round_cap(self, n: int) -> int:
         c = self.chunk
@@ -1316,6 +1345,55 @@ class Engine:
         return st, dict(stats=stats, par=st["opar"], lane=st["olane"],
                         st=st["ost"], inv=st["oinv"])
 
+    # ------------------------------------------------------------------
+    # job-axis batched burst (serve/batch): _burst_core with every
+    # per-job buffer riding a leading [J] axis — the multi-tenant
+    # serving layer packs many small (spec, config) jobs into ONE
+    # device program this way, amortizing compile and dispatch across
+    # tenants exactly as the burst amortizes them across levels.
+    # ------------------------------------------------------------------
+
+    def _batched_burst_impl(self, jst, lv_left, st_cap):
+        """Job-vmapped burst core.  ``jst`` stacks per-job state on a
+        leading job axis: vis (W-tuple of u32[J, VCAP] tables), claims
+        u32[J, VCAP], fr (narrow batch-last frontier rows [J, ..., KB]),
+        fm bool[J, KB], gd int32[J, KB], nf/g/pg int32[J]; ``lv_left``
+        and ``st_cap`` are per-job int32[J] depth/state gates (a
+        finished job passes lv_left=0 and never re-enters the loop).
+
+        Under vmap the burst's while_loops run until EVERY job's cond
+        is false, with per-job select masking: a finished job's state
+        freezes (its lanes contribute no further table writes or
+        appends) while stragglers keep stepping.  Each job's trajectory
+        is bit-identical to a solo burst — every op in the body is
+        per-lane-independent integer/boolean work, and the select only
+        ever replaces a finished job's next state with its frozen one
+        (tests/test_serve.py pins batched ≡ sequential on counts, level
+        sizes, violations and witness traces).
+
+        Returns (jst', out) with out's stats matrix and per-level
+        archives carrying the same leading [J] axis."""
+        def one(st, lvl, cap):
+            stf, out = self._burst_core(
+                st["vis"], st["claims"], st["fr"], st["fm"], st["gd"],
+                st["nf"], st["g"], st["pg"], self.FAM_CAPS, lvl, cap)
+            nst = dict(vis=stf["vis"], claims=stf["claims"],
+                       fr=stf["fr"], fm=stf["fm"], gd=stf["gd"],
+                       nf=stf["nf"], g=stf["g"], pg=stf["pg"])
+            return nst, out
+        return jax.vmap(one)(jst, lv_left, st_cap)
+
+    def burst_batched_fn(self):
+        """The jitted job-axis burst entry point (lazy: solo checks
+        never pay for it).  The serving layer AOT-compiles it per
+        (bucket, padded job count) via ``.lower(...).compile()`` so the
+        compile lands in one attributable span."""
+        if self._bat_jit is None:
+            _register_barrier_batching()
+            self._bat_jit = jax.jit(self._batched_burst_impl,
+                                    donate_argnums=0)
+        return self._bat_jit
+
     def _burst_impl(self, carry, fam_caps, levels_left, states_cap):
         """Classic-carry wrapper around _burst_core: slice the ring out
         of the LCAP buffers, run the fused loop, paste the surviving
@@ -2006,7 +2084,9 @@ class Engine:
             return self._arch.state_row(gid)
         off = 0
         for blk in self._states:
-            n = len(blk["ct"])
+            # any leaf's row count — key sets are spec-defined, so no
+            # named key can be assumed here
+            n = len(next(iter(blk.values())))
             if gid < off + n:
                 return _take(blk, gid - off)
             off += n

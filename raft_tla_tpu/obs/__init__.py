@@ -70,6 +70,7 @@ class Obs:
         self._profiling = False
         self._t0 = time.perf_counter()
         self._n_dispatch = 0
+        self._last_jobs = None
         if profile_dir and spans is not None:
             # device traces only line up with the host timeline if the
             # TraceAnnotation names match the span names
@@ -90,9 +91,15 @@ class Obs:
 
     def dispatch(self, *, kind: str, depth: int, frontier: int = 0,
                  metrics: Optional[Dict] = None,
-                 states: Optional[int] = None):
+                 states: Optional[int] = None,
+                 jobs: Optional[Dict] = None):
         """One record per dispatch (burst device call / per-level round
-        trip / sim dispatch): ledger line + heartbeat rewrite."""
+        trip / sim dispatch / batched multi-job call): ledger line +
+        heartbeat rewrite.  ``jobs`` is the serving layer's per-job
+        status map ({label: {depth, distinct, status}}): it rides the
+        heartbeat so ``tools/watch.py`` renders one line per job, and
+        the ledger record carries its live/total counts (full per-job
+        rows land as separate kind="job" records at job completion)."""
         self._n_dispatch += 1
         metrics = metrics or {}
         if states is None:
@@ -119,9 +126,24 @@ class Obs:
             dev = device_memory_stats()
             if dev:
                 rec["device_memory"] = dev
+            if jobs is not None:
+                rec["jobs_total"] = len(jobs)
+                rec["jobs_live"] = sum(
+                    1 for j in jobs.values()
+                    if j.get("status") == "running")
             self.ledger.record(rec)
+        if jobs is not None:
+            self._last_jobs = jobs
         if self.heartbeat is not None:
-            self.heartbeat.beat(depth=depth, states=states)
+            self.heartbeat.beat(depth=depth, states=states,
+                                extra={"jobs": jobs}
+                                if jobs is not None else None)
+
+    def set_jobs(self, jobs: Dict):
+        """Update the per-job status map the final heartbeat carries
+        (the serving layer records cache hits and fallback/sequential
+        jobs here — they finish outside any batched dispatch)."""
+        self._last_jobs = dict(jobs)
 
     # -- lifecycle (the CLI owns it) ----------------------------------
 
@@ -150,7 +172,11 @@ class Obs:
                 else self.heartbeat.last_depth,
                 states=int(states if states is not None
                            else self.heartbeat.last_states),
-                status=status)
+                status=status,
+                # a batch run's final beat keeps the per-job map, so
+                # watch renders the job lines next to FINISHED
+                extra={"jobs": self._last_jobs}
+                if self._last_jobs is not None else None)
         if self.ledger is not None:
             self.ledger.close()
         if self.spans is not None:

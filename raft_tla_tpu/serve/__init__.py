@@ -1,0 +1,18 @@
+"""Batched multi-tenant serving layer (ROADMAP 2b).
+
+Takes a list of (spec, config, engine-options) jobs, groups them into
+shape buckets, runs each bucket as ONE device program with a leading
+job axis (serve/batch), and short-circuits repeat jobs through a
+fingerprint-keyed result cache (serve/cache).  ``cli batch`` is the
+command-line front door; serve/jobs defines the job objects and the
+JSONL format.
+"""
+
+from .batch import (BatchReport, BucketEngine, JobOutcome, run_jobs)
+from .cache import ResultCache
+from .jobs import Job, job_from_dict, load_jobs
+
+__all__ = [
+    "BatchReport", "BucketEngine", "Job", "JobOutcome", "ResultCache",
+    "job_from_dict", "load_jobs", "run_jobs",
+]

@@ -1,0 +1,682 @@
+"""Multi-tenant batched checking: many (spec, config) jobs, ONE device
+program per bucket (ROADMAP 2b — the serving half of the north star).
+
+Every solo ``check`` pays its own compile (~6 s per engine instance on
+XLA:CPU; 30-50 s on the tunneled TPU) and its own dispatch chain, so N
+small jobs cost N× everything.  This layer amortizes both across
+tenants, the same move PR 5 made across levels:
+
+- **Buckets** — jobs group by their spec's ``serve_bucket`` hook:
+  (spec, ceiling config, bucket params).  One ``BucketEngine`` per
+  bucket compiles ONE job-vmapped burst program
+  (``engine/bfs.Engine.burst_batched_fn``) and serves every job in the
+  bucket through it, in waves of up to ``_MAX_WAVE`` jobs padded to a
+  power of two (so the wave-size compile cache stays tiny).
+- **Job axis** — per-job frontier rings, visited tables, global-id
+  cursors, depth gates and invariant verdicts all ride a leading
+  ``[J, ...]`` axis.  JAX batches the burst's while_loops as
+  run-until-all-jobs-done with per-job select masking: finished jobs
+  freeze (their lanes contribute no work to the result) while
+  stragglers keep stepping.  Each job's trajectory is bit-identical to
+  a solo run — pinned by tests/test_serve.py on counts, level sizes,
+  violation states and witness traces.
+- **Fallback** — a job the batched path cannot hold (root set or a
+  frontier outgrowing the per-job ring, a table overflow, seeded /
+  prefix-pinned configs) is re-run solo from scratch on an ordinary
+  ``Engine``; its batched partial progress is discarded, so fallback
+  results are trivially exact.  Fallbacks are counted and labeled
+  honestly in the report and the ledger.
+- **Result cache** — (spec, IR, config, options)-fingerprint keyed
+  (serve/cache): a repeat job is answered with zero device dispatches.
+- **Observability** — spans attribute wall-clock to ``bucket_compile``
+  vs ``batched_dispatch`` vs ``job_harvest`` (vs ``sequential_job``
+  for fallbacks); the ledger gets one ``kind="batch"`` record per
+  batched device call and one ``kind="job"`` record per finished job;
+  the heartbeat carries a per-job status map ``tools/watch.py``
+  renders one line per job.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import NULL_OBS
+from ..obs.metrics import check_stats
+from ..spec import C_OVERFLOW, spec_of
+from ..utils import take_arrays as _take
+from .jobs import Job
+
+U32MAX_NP = np.uint32(0xFFFFFFFF)
+
+# jobs per batched device program; a bucket with more runs extra waves
+_MAX_WAVE = 8
+
+# the serve_bucket contract's fallback when a spec declares no hook
+DEFAULT_BUCKET_PARAMS = dict(chunk=128, vcap=1 << 15, burst_levels=8)
+
+
+def _default_serve_bucket(cfg):
+    return cfg, dict(DEFAULT_BUCKET_PARAMS)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+# ---------------------------------------------------------------------------
+# per-job bookkeeping
+# ---------------------------------------------------------------------------
+
+class _JobRun:
+    """One job's in-flight state inside a batched wave: the CheckResult
+    under construction, the BFS cursors the harvest loop advances, and
+    the per-level trace archives (host RAM lists, the in-RAM Engine
+    archive format)."""
+
+    def __init__(self, job: Job):
+        from ..engine.bfs import CheckResult
+        self.job = job
+        self.res = CheckResult()
+        # per-job wall clock starts when the job enters its wave, so
+        # a job's reported seconds never absorb OTHER buckets' compile
+        # or runtime (it still shares its own wave's wall, honestly)
+        self._t0 = time.perf_counter()
+        self.depth = 0
+        self.n_states = 0
+        self.n_front = 0
+        self.parents: List[np.ndarray] = []
+        self.lanes: List[np.ndarray] = []
+        self.states: List[Dict[str, np.ndarray]] = []
+        self.live = True
+        self.fallback = False
+        self.fallback_reason: Optional[str] = None
+
+    def finish(self):
+        self.live = False
+        self.res.depth = self.depth
+        self.res.seconds = time.perf_counter() - self._t0
+
+    def mark_fallback(self, reason: str):
+        self.live = False
+        self.fallback = True
+        self.fallback_reason = reason
+
+    @property
+    def status(self) -> str:
+        return "running" if self.live else \
+            ("fallback" if self.fallback else "done")
+
+
+class JobOutcome:
+    """One job's final answer: status, the CheckResult (None for cache
+    hits), the JSON-able report row, and — when trace archives exist —
+    ``trace(gid)``/``get_state(gid)`` in the Engine format."""
+
+    def __init__(self, job: Job, status: str, res=None, report=None,
+                 archives=None, engine=None, reason=None):
+        self.job = job
+        self.status = status
+        self.res = res
+        self.report = report or {}
+        self._archives = archives      # (parents, lanes, states, labels)
+        self._engine = engine          # solo engine (fallback path)
+        self.reason = reason
+
+    @property
+    def cache_hit(self) -> bool:
+        return self.status == "cache_hit"
+
+    def get_state(self, gid: int):
+        if self._engine is not None:
+            return self._engine.get_state(gid)
+        if self._archives is None:
+            raise ValueError(f"job {self.job.label!r}: no trace "
+                             "archives (store_states off or cache hit)")
+        ir, lay = self.job.ir, self._archives[4]
+        _parents, _lanes, states, _labels = self._archives[:4]
+        off = 0
+        for blk in states:
+            n = next(iter(blk.values())).shape[0]
+            if gid < off + n:
+                return ir.decode(lay, _take(blk, gid - off))
+            off += n
+        raise IndexError(gid)
+
+    def trace(self, gid: int) -> List[Tuple]:
+        """Witness trace (label, state) chain — the Engine.trace
+        contract, replayed from the per-job archives."""
+        if self._engine is not None:
+            return self._engine.trace(gid)
+        if self._archives is None:
+            raise ValueError(f"job {self.job.label!r}: no trace "
+                             "archives (store_states off or cache hit)")
+        parents_l, lanes_l, _states, labels, _lay = self._archives
+        parents = np.concatenate(parents_l)
+        lanes = np.concatenate(lanes_l)
+        chain = []
+        g = gid
+        while g >= 0:
+            lane = int(lanes[g])
+            label = labels[lane] if lane >= 0 else "Init"
+            chain.append((label, self.get_state(g)[0]))
+            g = int(parents[g])
+        return list(reversed(chain))
+
+    def cache_payload(self) -> Dict:
+        return dict(self.report)
+
+    @classmethod
+    def _from_cache(cls, job: Job, payload: Dict) -> "JobOutcome":
+        report = dict(payload)
+        report["status"] = "cache_hit"
+        report["label"] = job.label
+        return cls(job, "cache_hit", report=report)
+
+
+class BatchReport:
+    """run_jobs' return value: outcomes in submission order + the
+    batch-level meta counters (buckets, compiles, dispatches, cache
+    hits, fallbacks)."""
+
+    def __init__(self, outcomes: List[JobOutcome], meta: Dict,
+                 seconds: float):
+        self.outcomes = outcomes
+        self.meta = dict(meta)
+        self.meta["seconds"] = round(seconds, 3)
+
+    @property
+    def summary(self) -> Dict:
+        return {"kind": "batch_summary", **self.meta,
+                "violations": sum(int(o.report.get("violations", 0))
+                                  for o in self.outcomes)}
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+def _build_report(job: Job, res, status: str, reason=None,
+                  tracer=None) -> Dict:
+    ir = spec_of(job.cfg)
+    out = check_stats(res.metrics.as_dict(), res.seconds,
+                      len(res.violations),
+                      fp_bits=128 if getattr(job.cfg, "fp128", False)
+                      else 64,
+                      spec=ir.name, ir_fp=ir.fingerprint())
+    out["label"] = job.label
+    out["status"] = status
+    if reason:
+        out["status_reason"] = reason
+    out["cfg_fingerprint"] = job.cfg_fingerprint()
+    out["opts_fingerprint"] = job.opts_fingerprint()
+    out["cache_key"] = job.cache_key()
+    out["level_sizes"] = [int(x) for x in res.level_sizes]
+    det = []
+    for v in res.violations[:8]:
+        d = {"invariant": v.invariant, "state_id": int(v.state_id)}
+        if tracer is not None and v.state_id >= 0:
+            d["trace"] = [lbl for lbl, _sv in tracer(v.state_id)]
+        det.append(d)
+    out["violations_detail"] = det
+    return out
+
+
+def _job_row(obs, outcome: JobOutcome):
+    if obs.ledger is None:
+        return
+    rec = dict(outcome.report)
+    rec["kind"] = "job"
+    obs.ledger.record(rec)
+
+
+def _jobs_map(runs: List[_JobRun]) -> Dict[str, Dict]:
+    return {run.job.label: {"depth": int(run.depth),
+                            "distinct": int(run.res.distinct_states),
+                            "status": run.status}
+            for run in runs}
+
+
+# ---------------------------------------------------------------------------
+# the bucket engine
+# ---------------------------------------------------------------------------
+
+class BucketEngine:
+    """One compiled batched checker per (spec, ceiling cfg, params)
+    bucket.  Wraps an ordinary ``Engine`` for the ceiling config and
+    drives its job-vmapped burst core; never calls ``Engine.check``,
+    so the solo executables are never traced or compiled here."""
+
+    def __init__(self, cfg, chunk: int = 128, vcap: int = 1 << 15,
+                 burst_levels: int = 8):
+        from ..engine.bfs import Engine
+        # dedup_kernel="off": the Pallas probe kernel has no batching
+        # rule; the lax claim walk is bit-identical in every mode
+        # (tests/test_guard_matmul.py pins it), so the batched program
+        # loses nothing but a TPU micro-optimization.  store_states
+        # stays off on the engine — serve harvests its own per-job
+        # archives straight from the burst outputs.
+        self.eng = Engine(cfg, chunk=chunk, store_states=False,
+                          vcap=vcap, dedup_kernel="off",
+                          burst_levels=burst_levels)
+        self.KB = self.eng._burst_width()
+        self.VCAP = self.eng.VCAP
+        self._fn = self.eng.burst_batched_fn()
+        self._compiled = {}            # padded J -> AOT executable
+
+    # -- root admission ------------------------------------------------
+
+    def _admit(self, run: _JobRun):
+        """Level-0 admission for one job — the host-side twin of
+        Engine.check's fresh-start path (roots dedup, invariant/
+        constraint eval, archive, table placement).  Returns the
+        per-job init arrays, or None when the root set cannot enter
+        the batched path."""
+        import jax.numpy as jnp
+
+        from ..engine.bfs import Violation
+        eng = self.eng
+        roots, rk, _pins = eng._dedup_roots(run.job.seed_states)
+        n = len(rk)
+        if n > min(self.KB, int(eng._LOAD_MAX * self.VCAP)):
+            run.mark_fallback(
+                f"{n} root states exceed the bucket ring/table")
+            return None
+        narrow_mj = {k: np.asarray(v) for k, v in
+                     eng.ir.narrow(eng.lay, eng.ir.widen(roots)).items()}
+        inv_r, con_r = eng._phase2(
+            {k: jnp.asarray(v) for k, v in roots.items()})
+        inv_r, con_r = np.asarray(inv_r), np.asarray(con_r)
+        res = run.res
+        res.distinct_states = n
+        res.generated_states = n
+        res.overflow_faults = int(
+            (np.asarray(roots["ctr"])[:, C_OVERFLOW] > 0).sum())
+        res.violations_global = int((~inv_r).sum())
+        eng._stamp_mode(res)
+        if run.job.store_states:
+            run.parents.append(np.full((n,), -1, np.int32))
+            run.lanes.append(np.full((n,), -1, np.int32))
+            run.states.append({k: v.copy()
+                               for k, v in narrow_mj.items()})
+        for jx, nm in enumerate(eng.inv_names):
+            for s in np.nonzero(~inv_r[:, jx])[0]:
+                vsv, vh = eng.ir.decode(eng.lay, _take(narrow_mj,
+                                                       int(s)))
+                res.violations.append(
+                    Violation(nm, int(s), state=vsv, hist=vh))
+        run.n_states = n
+        run.n_front = n
+        # the job is born finished when its gates already close
+        if run.job.max_depth <= 0 or \
+                res.distinct_states >= run.job.max_states or \
+                (run.job.stop_on_violation and res.violations):
+            run.finish()
+        fr = {k: np.zeros(v.shape[1:] + (self.KB,), v.dtype)
+              for k, v in narrow_mj.items()}
+        for k in fr:
+            fr[k][..., :n] = np.moveaxis(narrow_mj[k], 0, -1)
+        fm = np.zeros((self.KB,), bool)
+        fm[:n] = con_r
+        vis = np.full((eng.W, self.VCAP), U32MAX_NP, np.uint32)
+        slots = eng._host_probe_assign(rk, vcap=self.VCAP)
+        for w in range(eng.W):
+            vis[w][slots] = rk[:, w]
+        return dict(fr=fr, fm=fm, vis=vis, nf=n, g=n)
+
+    def _pad_init(self):
+        """A frozen placeholder job (nf=0): pads a wave to its
+        power-of-two width without contributing any work."""
+        eng = self.eng
+        one = eng.ir.narrow(eng.lay, eng.ir.encode(
+            eng.lay, *eng.ir.init_state(eng.cfg)))
+        fr = {k: np.zeros(v.shape + (self.KB,), v.dtype)
+              for k, v in one.items()}
+        fm = np.zeros((self.KB,), bool)
+        vis = np.full((eng.W, self.VCAP), U32MAX_NP, np.uint32)
+        return dict(fr=fr, fm=fm, vis=vis, nf=0, g=0)
+
+    def _stack(self, inits):
+        import jax.numpy as jnp
+        eng = self.eng
+        JP = len(inits)
+        return dict(
+            vis=tuple(jnp.asarray(np.stack([it["vis"][w]
+                                            for it in inits]))
+                      for w in range(eng.W)),
+            claims=jnp.full((JP, self.VCAP), np.uint32(U32MAX_NP)),
+            fr={k: jnp.asarray(np.stack([it["fr"][k] for it in inits]))
+                for k in inits[0]["fr"]},
+            fm=jnp.asarray(np.stack([it["fm"] for it in inits])),
+            gd=jnp.tile(jnp.arange(self.KB, dtype=jnp.int32)[None],
+                        (JP, 1)),
+            nf=jnp.asarray(np.array([it["nf"] for it in inits],
+                                    np.int32)),
+            g=jnp.asarray(np.array([it["g"] for it in inits],
+                                   np.int32)),
+            pg=jnp.zeros((JP,), jnp.int32),
+        )
+
+    # -- the wave driver -----------------------------------------------
+
+    def run_wave(self, runs: List[_JobRun], obs, meta: Dict,
+                 jobs_ctx: Optional[Dict] = None,
+                 verbose: bool = False):
+        """Run up to a wave of jobs to completion through the batched
+        burst.  Mutates the runs in place; jobs that bail are marked
+        for the sequential fallback.  ``jobs_ctx`` is the batch-global
+        per-job status map (heartbeat payload) this wave merges its
+        own statuses into."""
+        import jax.numpy as jnp
+        eng = self.eng
+        with obs.span("job_admit"):
+            admitted = []
+            for run in runs:
+                init = self._admit(run)
+                if init is not None:
+                    admitted.append((run, init))
+        if not any(run.live for run, _ in admitted):
+            for run, _ in admitted:
+                if not run.fallback:
+                    run.finish()
+            return
+        JP = _next_pow2(len(admitted))
+        inits = [init for _run, init in admitted]
+        inits += [self._pad_init()] * (JP - len(admitted))
+        jst = self._stack(inits)
+        while any(run.live for run, _ in admitted):
+            lv = np.zeros((JP,), np.int32)
+            cap = np.ones((JP,), np.int32)
+            for k, (run, _) in enumerate(admitted):
+                if run.live:
+                    lv[k] = min(eng.burst_levels,
+                                run.job.max_depth - run.depth)
+                    cap[k] = max(1, min(
+                        run.job.max_states - run.res.distinct_states,
+                        2 ** 31 - 1))
+            lvj, capj = jnp.asarray(lv), jnp.asarray(cap)
+            ex = self._compiled.get(JP)
+            if ex is None:
+                # AOT compile, in its own span: the bench and the
+                # ledger attribute bucket-compile seconds exactly
+                with obs.span("bucket_compile"):
+                    ex = self._fn.lower(jst, lvj, capj).compile()
+                self._compiled[JP] = ex
+            with obs.span("batched_dispatch"):
+                jst, out = ex(jst, lvj, capj)
+                stats = np.asarray(out["stats"])   # the ONE sync
+            meta["batch_dispatches"] += 1
+            with obs.span("job_harvest"):
+                for k, (run, _) in enumerate(admitted):
+                    if not run.live:
+                        continue
+                    # archives transfer PER JOB, and only for jobs
+                    # that keep traces or hit a violation — a wave
+                    # where one job stores never pays the whole
+                    # [J, levels, ...] stack's device-to-host cost
+                    need = run.job.store_states or stats[k, -1, 3]
+                    self._harvest(
+                        run, stats[k],
+                        np.asarray(out["par"][k]) if need else None,
+                        np.asarray(out["lane"][k]) if need else None,
+                        np.asarray(out["inv"][k]) if need else None,
+                        {nm: np.asarray(v[k])
+                         for nm, v in out["st"].items()}
+                        if need else None)
+            live_runs = [run for run, _ in admitted]
+            jobs_map = dict(jobs_ctx or {})
+            jobs_map.update(_jobs_map(live_runs))
+            if jobs_ctx is not None:
+                jobs_ctx.update(jobs_map)
+            obs.dispatch(
+                kind="batch",
+                depth=max((r.depth for r in live_runs), default=0),
+                frontier=sum(r.n_front for r in live_runs if r.live),
+                metrics={
+                    "distinct_states": sum(
+                        int(r.res.distinct_states) for r in live_runs),
+                    "generated_states": sum(
+                        int(r.res.generated_states)
+                        for r in live_runs)},
+                jobs=jobs_map)
+            if verbose:
+                done = sum(1 for r in live_runs if not r.live)
+                print(f"batch wave: {done}/{len(live_runs)} jobs done, "
+                      f"max depth "
+                      f"{max((r.depth for r in live_runs), default=0)}")
+
+    def _harvest(self, run: _JobRun, sj, par_j, lane_j, inv_j, st_j):
+        """One job's slice of a batched call — the solo burst harvest,
+        verbatim semantics (depth gating, pseudo-level skip, archive
+        rows, violation decode)."""
+        from ..engine.bfs import Violation
+        eng = self.eng
+        res = run.res
+        nlev = int(sj[-1, 0])
+        bailed = bool(sj[-1, 1])
+        res.burst_dispatches += 1
+        res.burst_bailouts += int(bailed)
+        if bailed:
+            # the job outgrew its per-job ring / table / family caps:
+            # discard the batched progress and re-run it solo (the solo
+            # engine owns every growth path).  Exact by construction.
+            run.mark_fallback("burst bailed (per-job ring or table "
+                              "overflow) — re-run sequentially")
+            return
+        for li in range(nlev):
+            n_lvl, n_viol, faults, n_expand, n_genl = (
+                int(x) for x in sj[li, :5])
+            res.distinct_states += n_lvl
+            res.generated_states += n_genl
+            res.overflow_faults += faults
+            res.violations_global += n_viol
+            if run.job.store_states and n_lvl:
+                run.parents.append(par_j[li, :n_lvl].copy())
+                run.lanes.append(lane_j[li, :n_lvl].copy())
+                run.states.append(
+                    {k: np.moveaxis(v[..., li, :n_lvl], -1, 0).copy()
+                     for k, v in st_j.items()})
+            elif run.job.store_states:
+                # zero-row levels still occupy an archive slot so gid
+                # arithmetic matches the solo archives
+                run.parents.append(np.zeros((0,), np.int32))
+                run.lanes.append(np.zeros((0,), np.int32))
+                run.states.append(
+                    {k: np.moveaxis(v[..., li, :0], -1, 0).copy()
+                     for k, v in st_j.items()})
+            if n_viol:
+                rows = {k: np.moveaxis(v[..., li, :n_lvl], -1, 0)
+                        for k, v in st_j.items()}
+                for jx, nm in enumerate(eng.inv_names):
+                    for s in np.nonzero(~inv_j[jx, li, :n_lvl])[0]:
+                        vsv, vh = eng.ir.decode(eng.lay,
+                                                _take(rows, int(s)))
+                        res.violations.append(
+                            Violation(nm, run.n_states + int(s),
+                                      state=vsv, hist=vh))
+            if n_lvl == 0 and n_genl == 0:
+                pass        # all-pruned pseudo-level: not a BFS level
+            else:
+                run.depth += 1
+                res.levels_fused += 1
+                res.level_sizes.append(n_expand)
+            run.n_states += n_lvl
+        run.n_front = int(sj[-1, 2])
+        if run.n_front == 0 or run.depth >= run.job.max_depth or \
+                res.distinct_states >= run.job.max_states or \
+                (run.job.stop_on_violation and res.violations):
+            run.finish()
+        elif nlev == 0:
+            # defensive: a live job that neither committed a level nor
+            # bailed would spin this driver forever — route it to the
+            # exact sequential path instead
+            run.mark_fallback("batched call made no progress")
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+def _run_solo(job: Job, obs, meta: Dict, status: str,
+              reason: Optional[str]) -> JobOutcome:
+    """One job on its own Engine (the sequential reference path):
+    used for --sequential runs, batched-path fallbacks, and seeded/
+    pinned jobs.  Engine dispatches ride the same obs bundle, so the
+    ledger records the solo device traffic honestly."""
+    from ..engine.bfs import Engine
+    with obs.span("sequential_job"):
+        eng = Engine(job.cfg, store_states=job.store_states)
+        meta["engines_compiled"] += 1
+        res = eng.check(max_depth=job.max_depth,
+                        max_states=job.max_states,
+                        stop_on_violation=job.stop_on_violation,
+                        seed_states=job.seed_states, obs=obs)
+    tracer = eng.trace if job.store_states else None
+    report = _build_report(job, res, status, reason=reason,
+                           tracer=tracer)
+    return JobOutcome(job, status, res=res, report=report, engine=eng,
+                      reason=reason)
+
+
+def run_jobs(jobs: List[Job], cache=None, obs=None,
+             sequential: bool = False, bucket_overrides=None,
+             verbose: bool = False) -> BatchReport:
+    """Serve a job list: cache lookups, shape-bucket grouping, batched
+    waves, sequential fallbacks, cache fill.  Returns a BatchReport
+    with outcomes in submission order.
+
+    sequential=True skips the batched path entirely (one solo Engine
+    per job) — the honest A/B reference bench.py records.
+    bucket_overrides overrides the per-spec bucket params (tests force
+    tiny rings with it to exercise the fallback)."""
+    obs = obs if obs is not None else NULL_OBS
+    t0 = time.perf_counter()
+    meta = dict(jobs=len(jobs), cache_hits=0, buckets=0,
+                engines_compiled=0, batch_dispatches=0,
+                fallback_jobs=0, sequential=bool(sequential))
+    # labels key the heartbeat/watch job map and the report rows —
+    # empty ones get positional names, duplicates get #N suffixes so
+    # two same-labeled jobs never collapse into one watch line.  (The
+    # Job objects are relabeled in place: the outcome rows must carry
+    # the same names the heartbeat used.)
+    seen_labels: Dict[str, int] = {}
+    for i, job in enumerate(jobs):
+        if not job.label:
+            job.label = f"job{i}"
+        base = job.label
+        if base in seen_labels:
+            n = seen_labels[base]
+            while f"{base}#{n + 1}" in seen_labels:
+                n += 1
+            seen_labels[base] = n + 1
+            job.label = f"{base}#{n + 1}"
+        seen_labels.setdefault(job.label, 1)
+    outcomes: List[Optional[JobOutcome]] = [None] * len(jobs)
+    # the batch-global per-job status map every heartbeat carries
+    jobs_ctx: Dict[str, Dict] = {}
+    pending: List[int] = []
+    key_first: Dict[str, int] = {}
+    dup_of: Dict[int, int] = {}
+    for i, job in enumerate(jobs):
+        key = job.cache_key()
+        hit = cache.get(key) if cache is not None else None
+        if hit is not None:
+            meta["cache_hits"] += 1
+            outcomes[i] = JobOutcome._from_cache(job, hit)
+            jobs_ctx[job.label] = {
+                "depth": int(hit.get("depth", 0)),
+                "distinct": int(hit.get("distinct_states", 0)),
+                "status": "cache_hit"}
+            _job_row(obs, outcomes[i])
+        elif key in key_first:
+            # two equal cache keys in one list are guaranteed the
+            # same result — compute once, answer the duplicate from
+            # the first job's outcome
+            dup_of[i] = key_first[key]
+        else:
+            key_first[key] = i
+            pending.append(i)
+    meta["deduped"] = len(dup_of)
+    solo: List[Tuple[int, str, Optional[str]]] = []
+    if sequential:
+        solo = [(i, "done", None) for i in pending]
+    else:
+        buckets: Dict[tuple, list] = {}
+        for i in pending:
+            job = jobs[i]
+            ir = spec_of(job.cfg)
+            if job.seed_states is not None or \
+                    getattr(job.cfg, "prefix_pins", ()):
+                solo.append((i, "fallback",
+                             "seeded/prefix-pinned jobs run "
+                             "sequentially"))
+                continue
+            hook = ir.serve_bucket or _default_serve_bucket
+            ceiling, params = hook(job.cfg)
+            params = dict(params)
+            params.update(bucket_overrides or {})
+            bkey = (ir.name, ir.fingerprint(), repr(ceiling),
+                    tuple(sorted(params.items())))
+            buckets.setdefault(bkey, [ceiling, params, []])[2].append(i)
+        meta["buckets"] = len(buckets)
+        for bkey, (ceiling, params, idxs) in buckets.items():
+            be = BucketEngine(ceiling, **params)
+            meta["engines_compiled"] += 1
+            for w0 in range(0, len(idxs), _MAX_WAVE):
+                wave = idxs[w0:w0 + _MAX_WAVE]
+                runs = [_JobRun(jobs[i]) for i in wave]
+                be.run_wave(runs, obs, meta, jobs_ctx=jobs_ctx,
+                            verbose=verbose)
+                for i, run in zip(wave, runs):
+                    if run.fallback:
+                        solo.append((i, "fallback",
+                                     run.fallback_reason))
+                        continue
+                    job = jobs[i]
+                    archives = ((run.parents, run.lanes, run.states,
+                                 be.eng.labels, be.eng.lay)
+                                if job.store_states else None)
+                    tracer = None
+                    outcome = JobOutcome(job, "done", res=run.res,
+                                         report=None,
+                                         archives=archives)
+                    if job.store_states:
+                        tracer = outcome.trace
+                    outcome.report = _build_report(job, run.res,
+                                                   "done",
+                                                   tracer=tracer)
+                    outcomes[i] = outcome
+    meta["fallback_jobs"] = sum(1 for _i, st, _r in solo
+                                if st == "fallback")
+    for i, status, reason in solo:
+        outcomes[i] = _run_solo(jobs[i], obs, meta, status, reason)
+        res = outcomes[i].res
+        jobs_ctx[jobs[i].label] = {"depth": int(res.depth),
+                                   "distinct":
+                                   int(res.distinct_states),
+                                   "status": status}
+    for i, src in dup_of.items():
+        payload = outcomes[src].cache_payload()
+        outcomes[i] = JobOutcome._from_cache(jobs[i], payload)
+        outcomes[i].report["status_reason"] = \
+            f"duplicate of job {jobs[src].label!r} in this batch"
+        jobs_ctx[jobs[i].label] = {
+            "depth": int(payload.get("depth", 0)),
+            "distinct": int(payload.get("distinct_states", 0)),
+            "status": "cache_hit"}
+        _job_row(obs, outcomes[i])
+    if jobs_ctx:
+        # the final heartbeat carries the whole batch's job map, incl.
+        # cache hits and solo jobs that never rode a batched dispatch
+        obs.set_jobs(jobs_ctx)
+    for outcome in outcomes:
+        if outcome.status == "cache_hit":
+            continue
+        if cache is not None:
+            cache.put(outcome.report["cache_key"],
+                      outcome.cache_payload())
+        _job_row(obs, outcome)
+    return BatchReport(outcomes, meta,
+                       seconds=time.perf_counter() - t0)

@@ -1,0 +1,60 @@
+"""Fingerprint-keyed result cache for the serving layer.
+
+Keys are ``Job.cache_key()`` — (spec name, IR structure fingerprint,
+config fingerprint, engine-options fingerprint) — so equal keys imply
+an identical ``CheckResult``; a hit short-circuits the job with ZERO
+device dispatches (the CI batch smoke asserts a re-run's ledger shows
+none).  Values are the per-job report payloads (serve/batch builds
+them): JSON-able counters, level sizes and violation summaries incl.
+witness trace labels.
+
+Storage is one JSON file per key under the cache directory, written
+atomically (write-then-rename), plus a per-process dict so repeat jobs
+inside one batch never touch the disk twice.  A corrupt or
+foreign-keyed file reads as a miss, never an error — the cache is an
+optimization, not a source of truth.  Eviction is deliberately absent
+(ROADMAP 2b remaining work); the directory is the operator's to prune.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+
+class ResultCache:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._mem: Dict[str, Dict] = {}
+
+    def _file(self, key: str) -> str:
+        return os.path.join(self.path, key + ".json")
+
+    def get(self, key: str) -> Optional[Dict]:
+        hit = self._mem.get(key)
+        if hit is not None:
+            return dict(hit)
+        try:
+            with open(self._file(key)) as fh:
+                obj = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(obj, dict) or obj.get("cache_key") != key:
+            return None          # foreign/corrupt payload: a miss
+        self._mem[key] = obj
+        return dict(obj)
+
+    def put(self, key: str, payload: Dict):
+        payload = dict(payload)
+        payload["cache_key"] = key
+        self._mem[key] = payload
+        tmp = self._file(key) + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, self._file(key))
+
+    def __len__(self) -> int:
+        return sum(1 for nm in os.listdir(self.path)
+                   if nm.endswith(".json"))

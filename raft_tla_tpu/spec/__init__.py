@@ -110,6 +110,13 @@ class SpecIR:
     prefix_pin_seeds: Optional[Callable] = None   # cfg -> (seeds, interiors)
     sim_progress: Optional[Callable] = None       # (kern, lay) -> (svT -> [W])
     default_config: Optional[Callable] = None     # () -> a small cfg
+    # serving-layer bucket ceiling (serve/batch): cfg -> (ceiling cfg,
+    # bucket param dict).  Jobs whose ceiling cfg + params match batch
+    # into ONE job-vmapped device program; the ceiling is the config
+    # the bucket engine compiles (== cfg until a spec can pad value
+    # bounds up, which needs runtime guard thresholds — ROADMAP 2b),
+    # and the params size the per-job rings for small serving jobs.
+    serve_bucket: Optional[Callable] = None
 
     @property
     def all_keys(self) -> Tuple[str, ...]:

@@ -80,6 +80,17 @@ def sim_progress(kern, lay):
     return score
 
 
+def serve_bucket(cfg):
+    """Bucket ceiling for the batched serving layer (serve/batch): the
+    same exact-ceiling v1 contract as the raft hook — ballots/values/
+    acceptors/instances all shape the packed message universe and the
+    quorum closed forms, so jobs batch on an identical config and
+    differ in depth/state gates and option sets.  Paxos states are
+    tiny (a u32 msgs bitmask + [I, N] acceptor arrays), so the default
+    small-job ring (4 * chunk rows, 2^15-slot table) is generous."""
+    return cfg, dict(chunk=128, vcap=1 << 15, burst_levels=8)
+
+
 def build_ir() -> SpecIR:
     from . import layout as codec
     from .config import PaxosConfig
@@ -125,4 +136,5 @@ def build_ir() -> SpecIR:
         prefix_pin_seeds=None,
         sim_progress=sim_progress,
         default_config=PaxosConfig,
+        serve_bucket=serve_bucket,
     )

@@ -179,6 +179,27 @@ def sim_progress(kern, lay):
     return score
 
 
+def serve_bucket(cfg):
+    """Bucket ceiling for the batched serving layer (serve/batch).
+
+    Jobs whose compiled operator surface is identical batch into one
+    job-vmapped device program.  Every Raft constant is shape- or
+    guard-bearing (constants compile into the packed layout and the
+    int8 guard matrix, bounds into the constraint predicates), so the
+    v1 ceiling is exact: ceiling == cfg and the bucket key is the full
+    config repr — jobs still amortize compile/dispatch whenever many
+    tenants check the same model under different depth/state gates or
+    option sets.  Padding value-like bounds (MaxTerm etc.) up to a
+    shared ceiling needs per-job guard thresholds threaded through the
+    expander; that remaining half is recorded in ROADMAP 2b.
+
+    The params size the per-job rings for small serving jobs: ring =
+    4 * chunk frontier rows per job, a 2^15-slot visited table
+    (~13k keys at the 0.40 load bound).  A job outgrowing either bails
+    to the sequential fallback."""
+    return cfg, dict(chunk=128, vcap=1 << 15, burst_levels=8)
+
+
 # ---------------------------------------------------------------------------
 # IR assembly
 # ---------------------------------------------------------------------------
@@ -229,4 +250,5 @@ def build_ir() -> SpecIR:
         prefix_pin_seeds=prefix_pin_seeds,
         sim_progress=sim_progress,
         default_config=None,
+        serve_bucket=serve_bucket,
     )

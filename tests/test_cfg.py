@@ -100,3 +100,104 @@ def test_cli_trace_first_commit():
     assert r.returncode == 0, r.stderr
     assert "witness for FirstCommit" in r.stdout
     assert "AdvanceCommitIndex" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# TLC .cfg front-end for paxos constants (ROADMAP 2a leftover):
+# `--spec paxos model.cfg` parses CONSTANTS into PaxosConfig, with
+# clear errors naming unsupported keys, round-tripping against the
+# JSON constants path.
+# ---------------------------------------------------------------------------
+
+PAXOS_CFG_TEXT = """\
+\\* small paxos model
+CONSTANTS
+  a1 = 1
+  a2 = 2
+  a3 = 3
+  Acceptor = {a1, a2, a3}
+  Ballot = {0, 1}
+  Value = {0, 1}
+  Instances = 2
+SYMMETRY perms
+INIT Init
+NEXT Next
+INVARIANTS
+  Agreement
+  Validity
+"""
+
+
+def test_paxos_cfg_roundtrips_with_json_path(tmp_path):
+    from raft_tla_tpu.cfg.parser import (load_paxos_model,
+                                         paxos_config_from_obj)
+    p = tmp_path / "paxos.cfg"
+    p.write_text(PAXOS_CFG_TEXT)
+    cfg = load_paxos_model(str(p))
+    assert (cfg.n_servers, cfg.n_ballots, cfg.n_values,
+            cfg.n_instances) == (3, 2, 2, 2)
+    assert cfg.symmetry is True
+    assert cfg.invariants == ("Agreement", "Validity")
+    # round-trip: the JSON constants path builds the identical config
+    via_json = paxos_config_from_obj(
+        {"acceptors": 3, "ballots": 2, "values": 2, "instances": 2,
+         "symmetry": True, "invariants": ["Agreement", "Validity"]},
+        where="json")
+    assert cfg == via_json
+    # no SYMMETRY line -> symmetry off (TLC semantics); no INVARIANT
+    # lines -> the spec defaults
+    p2 = tmp_path / "plain.cfg"
+    p2.write_text("CONSTANTS\n  a1 = 1\n  Acceptor = {a1}\n"
+                  "  Ballot = {0}\n  Value = {0}\n")
+    cfg2 = load_paxos_model(str(p2))
+    assert cfg2.symmetry is False and cfg2.n_servers == 1
+    assert cfg2 == paxos_config_from_obj(
+        {"acceptors": 1, "ballots": 1, "values": 1, "symmetry": False},
+        where="json")
+
+
+def test_paxos_cfg_clear_errors(tmp_path):
+    from raft_tla_tpu.cfg.parser import CfgError, load_paxos_model
+
+    def expect(text, pattern):
+        p = tmp_path / "bad.cfg"
+        p.write_text(text)
+        with pytest.raises(CfgError, match=pattern):
+            load_paxos_model(str(p))
+
+    base = "CONSTANTS\n  a1 = 1\n  Acceptor = {a1}\n"
+    # unsupported constant, by name
+    expect(base + "  Frob = {a1}\n", "unsupported paxos CONSTANT 'Frob'")
+    # Quorum is derived
+    expect(base + "  Quorum = {a1}\n", "Quorum is not cfg-settable")
+    # non-dense ballot set
+    expect(base + "  Ballot = {1, 3}\n", "contiguous set 0..N-1")
+    # unknown invariant names the spec (the shared JSON-path message)
+    expect(base + "INVARIANT NotAThing\n",
+           r"unknown invariant\(s\) 'NotAThing' for spec 'paxos'")
+    # paxos declares no constraints
+    expect(base + "CONSTRAINT Bounded\n", "declares no constraints")
+    # unsupported NEXT family
+    expect(base + "NEXT NextAsync\n", "unsupported NEXT")
+
+
+def test_cli_check_paxos_cfg_matches_json(tmp_path):
+    """`--spec paxos model.cfg` end-to-end: the .cfg and the JSON
+    constants path land on identical counts."""
+    cfg_p = tmp_path / "m.cfg"
+    cfg_p.write_text("CONSTANTS\n  a1 = 1\n  a2 = 2\n"
+                     "  Acceptor = {a1, a2}\n  Ballot = {0}\n"
+                     "  Value = {0}\n")
+    json_p = tmp_path / "m.json"
+    json_p.write_text(json.dumps(
+        {"acceptors": 2, "ballots": 1, "values": 1,
+         "symmetry": False}))
+    outs = {}
+    for name, path in (("cfg", cfg_p), ("json", json_p)):
+        r = run_cli("check", str(path), "--spec", "paxos",
+                    "--engine", "oracle", "--max-depth", "4")
+        assert r.returncode == 0, r.stderr
+        outs[name] = json.loads(r.stdout.splitlines()[0])
+    assert outs["cfg"]["distinct_states"] == \
+        outs["json"]["distinct_states"]
+    assert outs["cfg"]["depth"] == outs["json"]["depth"]

@@ -1,0 +1,297 @@
+"""Batched multi-tenant serving layer (serve/): batched ≡ sequential
+bit-exactness, cache hit/miss paths, fallbacks, job parsing, and the
+multi-job observability surface.
+
+One fast representative of each contract runs in tier-1; the
+full-space duplicates are slow-marked (tier-1 budget, ROADMAP
+standing constraint).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+from raft_tla_tpu.engine.bfs import Engine
+from raft_tla_tpu.serve import (Job, ResultCache, job_from_dict,
+                                load_jobs, run_jobs)
+from raft_tla_tpu.spec.paxos.config import PaxosConfig
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+PAX = PaxosConfig(n_servers=2, n_ballots=2, n_values=1)
+
+
+def _same(res, ref):
+    assert (res.distinct_states, res.generated_states, res.depth) == \
+        (ref.distinct_states, ref.generated_states, ref.depth)
+    assert res.level_sizes == ref.level_sizes
+    assert [(v.invariant, v.state_id) for v in res.violations] == \
+        [(v.invariant, v.state_id) for v in ref.violations]
+
+
+def _trace_key(trace):
+    return [(label, repr(sv)) for label, sv in trace]
+
+
+def test_batched_mixed_specs_bit_exact():
+    """The tier-1 representative: a mixed raft+paxos job list through
+    the batched path lands bit-exact against per-job sequential
+    engines — counts, level sizes, violation ids AND witness traces —
+    while compiling exactly one engine per (spec, bucket)."""
+    jobs = [Job(MICRO, max_depth=4, label="r4"),
+            Job(MICRO, max_depth=6, label="r6"),
+            Job(PAX, max_depth=3, label="p3"),
+            Job(PAX, label="pfull")]
+    rep = run_jobs(jobs)
+    assert rep.meta["buckets"] == 2
+    assert rep.meta["engines_compiled"] == 2
+    assert rep.meta["fallback_jobs"] == 0
+    assert all(o.status == "done" for o in rep.outcomes)
+    re_r, re_p = Engine(MICRO), Engine(PAX)
+    _same(rep.outcomes[0].res, re_r.check(max_depth=4))
+    _same(rep.outcomes[2].res, re_p.check(max_depth=3))
+    ref6 = re_r.check(max_depth=6)
+    _same(rep.outcomes[1].res, ref6)
+    # witness-trace parity: the deepest raft state replays identically
+    # from the per-job batched archives and the solo engine's
+    last = ref6.distinct_states - 1
+    assert _trace_key(rep.outcomes[1].trace(last)) == \
+        _trace_key(re_r.trace(last))
+    refp = re_p.check()
+    _same(rep.outcomes[3].res, refp)
+    lastp = refp.distinct_states - 1
+    assert _trace_key(rep.outcomes[3].trace(lastp)) == \
+        _trace_key(re_p.trace(lastp))
+    # the stats stamps every job row carries
+    row = rep.outcomes[3].report
+    assert row["spec"] == "paxos" and row["status"] == "done"
+    assert row["cache_key"].startswith("paxos-")
+
+
+def test_batched_violation_states_and_witness_parity():
+    """A job that FINDS a violation (ValueChosen as invariant, the
+    trace-command idiom): the batched run reports the same violating
+    state ids and replays the same witness trace as the sequential
+    engine, and stop_on_violation gates identically."""
+    vcfg = PAX.with_(invariants=("ValueChosen",))
+    rep = run_jobs([Job(vcfg, label="vc")])
+    o = rep.outcomes[0]
+    assert o.status == "done"
+    ref_eng = Engine(vcfg)
+    ref = ref_eng.check(stop_on_violation=True)
+    _same(o.res, ref)
+    assert o.res.violations, "expected a ValueChosen witness"
+    sid = o.res.violations[0].state_id
+    assert _trace_key(o.trace(sid)) == _trace_key(ref_eng.trace(sid))
+    det = o.report["violations_detail"]
+    assert det and det[0]["invariant"] == "ValueChosen"
+    assert det[0]["trace"] == [lbl for lbl, _ in ref_eng.trace(sid)]
+
+
+def test_result_cache_hit_and_fingerprint_misses(tmp_path):
+    """Cache round-trip: an identical job is served with ZERO device
+    work; any changed fingerprint component (engine options, config)
+    misses."""
+    cache = ResultCache(str(tmp_path))
+    rep1 = run_jobs([Job(PAX, max_depth=2, label="a")], cache=cache)
+    assert rep1.meta["cache_hits"] == 0
+    assert rep1.meta["batch_dispatches"] >= 1
+    # identical (cfg, options) under a different label: a hit, no
+    # engine, no dispatch
+    rep2 = run_jobs([Job(PAX, max_depth=2, label="b")], cache=cache)
+    assert rep2.meta["cache_hits"] == 1
+    assert rep2.meta["batch_dispatches"] == 0
+    assert rep2.meta["engines_compiled"] == 0
+    o = rep2.outcomes[0]
+    assert o.status == "cache_hit" and o.cache_hit
+    assert o.report["distinct_states"] == \
+        rep1.outcomes[0].report["distinct_states"]
+    assert o.report["level_sizes"] == \
+        rep1.outcomes[0].report["level_sizes"]
+    # options-fingerprint misses: depth gate, stop-on-violation,
+    # store toggle all key separately
+    assert cache.get(Job(PAX, max_depth=3).cache_key()) is None
+    assert cache.get(Job(PAX, max_depth=2,
+                         stop_on_violation=False).cache_key()) is None
+    assert cache.get(Job(PAX, max_depth=2,
+                         store_states=False).cache_key()) is None
+    # config-fingerprint miss
+    assert cache.get(Job(PAX.with_(n_ballots=1),
+                         max_depth=2).cache_key()) is None
+    # the payload survives a fresh cache handle (disk round-trip)
+    fresh = ResultCache(str(tmp_path))
+    key = Job(PAX, max_depth=2).cache_key()
+    assert fresh.get(key)["distinct_states"] == \
+        rep1.outcomes[0].report["distinct_states"]
+
+
+def test_ring_overflow_falls_back_sequential_exact():
+    """A job whose frontier outgrows the per-job ring bails out of the
+    batched program and re-runs solo — results stay exact and the
+    fallback is reported honestly."""
+    rep = run_jobs([Job(MICRO, label="big")],
+                   bucket_overrides=dict(chunk=16, vcap=1 << 10))
+    assert rep.meta["fallback_jobs"] == 1
+    o = rep.outcomes[0]
+    assert o.status == "fallback"
+    assert "re-run sequentially" in o.report["status_reason"]
+    _same(o.res, Engine(MICRO).check())
+
+
+def test_job_from_dict_format_and_errors(tmp_path):
+    cfg_path = os.path.join(_REPO, "configs", "tlc_membership",
+                            "raft.cfg")
+    job = job_from_dict({
+        "spec": "raft", "config": cfg_path,
+        "overrides": {"servers": 2, "values": [1], "max_inflight": 4,
+                      "next": "NextAsync",
+                      "bounds": {"max_log_length": 1,
+                                 "max_timeouts": 1,
+                                 "max_client_requests": 1}},
+        "max_depth": 3, "label": "r"})
+    assert job.cfg.n_servers == 2 and job.cfg.values == (1,)
+    assert job.cfg.max_inflight == 4
+    assert job.cfg.bounds.max_terms == 2       # derived: timeouts + 1
+    assert job.max_depth == 3 and job.stop_on_violation
+    pj = job_from_dict({"spec": "paxos",
+                        "config": {"acceptors": 2, "ballots": 2,
+                                   "values": 1},
+                        "keep_going": True})
+    assert pj.cfg == PAX.with_() and not pj.stop_on_violation
+    # errors name the offending key
+    with pytest.raises(ValueError, match="unknown job key.*'frobnicate'"):
+        job_from_dict({"spec": "paxos", "frobnicate": 1})
+    with pytest.raises(ValueError, match="unknown raft override.*'speed'"):
+        job_from_dict({"spec": "raft", "config": cfg_path,
+                       "overrides": {"speed": 11}})
+    with pytest.raises(ValueError, match="unknown paxos config key 'qs'"):
+        job_from_dict({"spec": "paxos", "config": {"qs": 3}})
+    with pytest.raises(ValueError, match="raft-only"):
+        job_from_dict({"spec": "paxos", "overrides": {"servers": 2}})
+    with pytest.raises(ValueError, match="max_depth"):
+        job_from_dict({"spec": "paxos", "max_depth": -1})
+    # JSONL loader: comments/blank lines skipped, line numbers in errors
+    p = tmp_path / "jobs.jsonl"
+    p.write_text('# comment\n\n{"spec": "paxos", "max_depth": 2}\n')
+    assert len(load_jobs(str(p))) == 1
+    p.write_text('{"spec": "nope"}\n')
+    with pytest.raises(ValueError, match="jobs.jsonl:1.*unknown spec"):
+        load_jobs(str(p))
+
+
+def test_cache_keys_are_spec_and_ir_scoped():
+    """Same options, different specs/configs never collide: the key
+    embeds the spec name, IR structure fingerprint and cfg repr."""
+    k1 = Job(PAX, max_depth=4).cache_key()
+    k2 = Job(MICRO, max_depth=4).cache_key()
+    k3 = Job(PAX, max_depth=4).cache_key()
+    assert k1 != k2 and k1 == k3
+    assert k1.startswith("paxos-") and k2.startswith("raft-")
+
+
+def test_watch_renders_multi_job_heartbeat(tmp_path):
+    """tools/watch.py multi-job mode: a batch heartbeat's per-job map
+    renders one status line per job."""
+    from raft_tla_tpu.obs.heartbeat import Heartbeat
+    spec = importlib.util.spec_from_file_location(
+        "watch", os.path.join(_REPO, "tools", "watch.py"))
+    watch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(watch)
+    hb_path = str(tmp_path / "hb.json")
+    hb = Heartbeat(hb_path)
+    hb.beat(depth=4, states=34, extra={"jobs": {
+        "r4": {"depth": 4, "distinct": 29, "status": "done"},
+        "p3": {"depth": 3, "distinct": 5, "status": "running"}}})
+    line, code = watch.status_line(hb_path, None, stale_s=300)
+    assert code == 0
+    assert "job r4: depth 4  29 states  done" in line
+    assert "job p3: depth 3  5 states  running" in line
+    # single-run heartbeats render exactly as before
+    hb2 = Heartbeat(str(tmp_path / "hb2.json"))
+    hb2.beat(depth=2, states=9)
+    line2, _ = watch.status_line(str(tmp_path / "hb2.json"), None, 300)
+    assert "job " not in line2 and "\n" not in line2
+
+
+def test_batch_obs_ledger_rows_and_heartbeat(tmp_path):
+    """The obs threading: one kind='batch' ledger record per batched
+    device call, one kind='job' row per job, per-job heartbeat map,
+    and span timelines attributing bucket_compile vs batched_dispatch
+    vs job_harvest."""
+    from raft_tla_tpu.obs import Obs
+    from raft_tla_tpu.obs.heartbeat import Heartbeat
+    from raft_tla_tpu.obs.ledger import RunLedger
+    from raft_tla_tpu.obs.spans import SpanRecorder
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    rec = SpanRecorder()
+    obs = Obs(spans=rec, ledger=RunLedger(ledger_path),
+              heartbeat=Heartbeat(str(tmp_path / "hb.json")))
+    obs.start()
+    rep = run_jobs([Job(PAX, max_depth=2, label="p")], obs=obs)
+    obs.finish(depth=2, states=int(
+        rep.outcomes[0].res.distinct_states))
+    recs = [json.loads(ln) for ln in open(ledger_path)]
+    kinds = [r.get("kind") for r in recs]
+    assert "batch" in kinds and "job" in kinds
+    batch_rec = next(r for r in recs if r["kind"] == "batch")
+    assert batch_rec["jobs_total"] == 1
+    job_rec = next(r for r in recs if r["kind"] == "job")
+    assert job_rec["label"] == "p" and job_rec["status"] == "done"
+    hb = json.load(open(tmp_path / "hb.json"))
+    assert hb["status"] == "finished" and "p" in hb["jobs"]
+    totals = rec.totals()
+    for nm in ("bucket_compile", "batched_dispatch", "job_harvest"):
+        assert nm in totals and totals[nm]["count"] >= 1, (nm, totals)
+
+
+# ---------------------------------------------------------------------------
+# slow duplicates: bigger spaces, bigger waves
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_batched_stock_paxos_and_deep_raft_parity_slow():
+    """Full-space duplicates of the fast representative: the stock
+    paxos model (857 distinct symmetric, fully batched) mixed with the
+    raft micro space to exhaustion (20,438 distinct, peak level 740 —
+    deliberately NOT a small job: it must overflow the per-job burst
+    ring, fall back to a solo engine, and still land exact with an
+    honest status).  The ring/table are widened (4*256 rows, 2^17
+    slots) so the fallback is the burst's own bail, not the root
+    admission check."""
+    stock = PaxosConfig()
+    jobs = [Job(stock, label="stock"),
+            Job(MICRO, label="micro-full"),
+            Job(MICRO, max_depth=5, label="micro-d5")]
+    rep = run_jobs(jobs, bucket_overrides=dict(chunk=256,
+                                               vcap=1 << 17))
+    assert rep.meta["buckets"] == 2
+    refs = [Engine(stock).check(), Engine(MICRO).check(),
+            Engine(MICRO).check(max_depth=5)]
+    statuses = [o.status for o in rep.outcomes]
+    assert statuses == ["done", "fallback", "done"], statuses
+    assert rep.meta["fallback_jobs"] == 1
+    for o, ref in zip(rep.outcomes, refs):
+        _same(o.res, ref)
+
+
+@pytest.mark.slow
+def test_batched_wave_of_identical_options_slow():
+    """A wave wider than a power of two boundary (5 jobs -> padded to
+    8) with mixed depth gates, all one bucket — stragglers keep
+    stepping while short jobs freeze."""
+    jobs = [Job(MICRO, max_depth=d, label=f"d{d}")
+            for d in (2, 3, 4, 5, 6)]
+    rep = run_jobs(jobs)
+    assert rep.meta["buckets"] == 1
+    assert rep.meta["engines_compiled"] == 1
+    eng = Engine(MICRO)
+    for o, d in zip(rep.outcomes, (2, 3, 4, 5, 6)):
+        _same(o.res, eng.check(max_depth=d))

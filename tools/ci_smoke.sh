@@ -20,3 +20,8 @@ env JAX_PLATFORMS=cpu python tools/guard_matmul_smoke.py
 # engine-layer grep gate (engine/ and parallel/ must never import
 # models.raft directly — everything routes through the SpecIR handle)
 env JAX_PLATFORMS=cpu python tools/paxos_smoke.py
+# batch-serving gate (round 11): two tiny jobs (raft + paxos, the
+# paxos one through the TLC .cfg front-end) through `cli batch`, then
+# a re-run asserting the second invocation is served entirely from the
+# fingerprint-keyed result cache — 0 device dispatches in the ledger
+env JAX_PLATFORMS=cpu python tools/serve_smoke.py

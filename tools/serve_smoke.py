@@ -1,0 +1,113 @@
+"""CI batch-serving smoke: `cli batch` end-to-end, then the cache.
+
+Two tiny jobs (one raft, one paxos — the paxos one through the TLC
+.cfg front-end) run through ``python -m raft_tla_tpu batch`` with a
+result cache and a ledger; a second invocation of the SAME job list
+must then be served entirely from the fingerprint-keyed cache: every
+job row says cache_hit, the summary reports zero batched dispatches
+and zero engines compiled, and the re-run's ledger contains NO device
+dispatch records of any kind (kind=batch/burst/level) — only the
+kind=job completion rows.  Exercises: JSONL parsing, bucketing, the
+job-vmapped burst, report assembly, ResultCache round-trip, and the
+obs threading (ledger + heartbeat incl. the per-job map).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PAXOS_CFG = """\\* tiny paxos model (batch smoke)
+CONSTANTS
+  a1 = 1
+  a2 = 2
+  Acceptor = {a1, a2}
+  Ballot = {0}
+  Value = {0}
+INIT Init
+NEXT Next
+INVARIANT Agreement
+"""
+
+
+def run_batch(jobs_path, cache_dir, ledger, heartbeat):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tla_tpu", "batch",
+         "--jobs", jobs_path, "--cache-dir", cache_dir,
+         "--ledger", ledger, "--heartbeat", heartbeat],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    assert p.returncode == 0, (p.returncode, p.stdout, p.stderr)
+    lines = [json.loads(ln) for ln in p.stdout.splitlines() if ln]
+    summary, rows = lines[0], lines[1:]
+    assert summary["kind"] == "batch_summary", summary
+    return summary, rows
+
+
+def ledger_kinds(path):
+    kinds = []
+    with open(path) as fh:
+        for line in fh:
+            kinds.append(json.loads(line).get("kind"))
+    return kinds
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="serve_smoke_")
+    pax_cfg = os.path.join(tmp, "paxos.cfg")
+    with open(pax_cfg, "w") as fh:
+        fh.write(PAXOS_CFG)
+    jobs = [
+        {"spec": "raft", "config": "configs/tlc_membership/raft.cfg",
+         "overrides": {"servers": 2, "values": [1], "max_inflight": 4,
+                       "next": "NextAsync",
+                       "bounds": {"max_log_length": 1,
+                                  "max_timeouts": 1,
+                                  "max_client_requests": 1}},
+         "max_depth": 3, "label": "raft-micro"},
+        {"spec": "paxos", "config": pax_cfg, "max_depth": 3,
+         "label": "paxos-micro"},
+    ]
+    jobs_path = os.path.join(tmp, "jobs.jsonl")
+    with open(jobs_path, "w") as fh:
+        for obj in jobs:
+            fh.write(json.dumps(obj) + "\n")
+    cache = os.path.join(tmp, "cache")
+    hb = os.path.join(tmp, "hb.json")
+
+    # run 1: cold — both jobs computed, batched, one bucket per spec
+    s1, rows1 = run_batch(jobs_path, cache, os.path.join(tmp, "l1"),
+                          hb)
+    assert s1["jobs"] == 2 and s1["cache_hits"] == 0, s1
+    assert s1["buckets"] == 2 and s1["batch_dispatches"] >= 2, s1
+    assert all(r["status"] == "done" for r in rows1), rows1
+    k1 = ledger_kinds(os.path.join(tmp, "l1"))
+    assert "batch" in k1 and k1.count("job") == 2, k1
+    with open(hb) as fh:
+        hb1 = json.load(fh)
+    assert set(hb1.get("jobs", {})) == {"raft-micro", "paxos-micro"}, \
+        hb1
+
+    # run 2: identical list — served ENTIRELY from the result cache,
+    # zero device dispatches in the ledger
+    s2, rows2 = run_batch(jobs_path, cache, os.path.join(tmp, "l2"),
+                          hb)
+    assert s2["cache_hits"] == 2, s2
+    assert s2["batch_dispatches"] == 0 and \
+        s2["engines_compiled"] == 0, s2
+    assert all(r["status"] == "cache_hit" for r in rows2), rows2
+    for a, b in zip(rows1, rows2):
+        assert a["distinct_states"] == b["distinct_states"] and \
+            a["level_sizes"] == b["level_sizes"], (a, b)
+    k2 = ledger_kinds(os.path.join(tmp, "l2"))
+    assert set(k2) == {"job"}, \
+        f"cached re-run must dispatch nothing, ledger kinds: {k2}"
+    print("serve_smoke: OK (2 jobs batched; re-run 100% cache, "
+          "0 device dispatches)")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,12 @@ A heartbeat older than ``--stale`` seconds (default 300 — a slow level
 on the tunneled runtime can legitimately take minutes) or a dead pid
 flags the run STALLED/DEAD.
 
+Multi-job mode: a batch heartbeat (``cli batch`` — the serving layer)
+carries a per-job status map; one extra line renders per job:
+
+  job raft-micro: depth 4  29 states  done
+  job paxos-micro: depth 3  44 states  running
+
 Usage:
   python tools/watch.py HEARTBEAT [--ledger FILE] [--interval SEC]
                         [--stale SEC] [--once]
@@ -58,8 +64,20 @@ def last_ledger_records(path, n=2):
     return recs[-n:]
 
 
+def job_lines(hb):
+    """One rendered status line per job of a batch heartbeat (the
+    serving layer's per-job map); [] for single-run heartbeats."""
+    out = []
+    for name, j in (hb.get("jobs") or {}).items():
+        out.append(f"  job {name}: depth {int(j.get('depth', 0))}  "
+                   f"{int(j.get('distinct', 0)):,} states  "
+                   f"{j.get('status', '?')}")
+    return out
+
+
 def status_line(hb_path, ledger_path, stale_s):
-    """(line, exit_code): 0 healthy, 1 stalled/dead, 2 unreadable."""
+    """(line, exit_code): 0 healthy, 1 stalled/dead, 2 unreadable.
+    Batch heartbeats append one line per job (job_lines)."""
     try:
         hb = read_heartbeat(hb_path)
     except (OSError, ValueError) as e:
@@ -97,7 +115,11 @@ def status_line(hb_path, ledger_path, stale_s):
         code = 1
     else:
         parts.append(f"pid {hb['pid']} alive")
-    return "  ".join(parts), code
+    line = "  ".join(parts)
+    jl = job_lines(hb)
+    if jl:
+        line = "\n".join([line] + jl)
+    return line, code
 
 
 def main(argv=None):
