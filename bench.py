@@ -326,6 +326,167 @@ def _matmul_ab(out_path):
     return out
 
 
+def _delta_ab(out_path):
+    """Delta-matmul successor-generation A/B (BENCH round 11, ROADMAP
+    item 3): the same micro space checked with delta_matmul ON (every
+    declared family applies as ONE batched scatter-as-matmul per
+    family group) vs OFF (the per-family vmapped kernels), counts
+    correctness-gated identical for raft AND paxos — the paxos pair
+    doubles as the zero-new-kernels proof (all four families run from
+    declarations alone).
+
+    On top of the end-to-end rows, a STANDALONE expansion-phase
+    micro-pair times the replaced primitive directly on config #2's
+    lane mix (the engines fuse materialize inside one jit, so
+    per-phase wall-clock needs standalone dispatch):
+
+    - ``delta_apply`` — jitted ``Expander.materialize`` with the group
+      delta matmul compiled (int32 einsum blocks);
+    - ``delta_kernels`` — the identical call with the per-family
+      kernel path.
+
+    Off-TPU the einsum blocks run on XLA:CPU — the seconds measure the
+    fallback, not the matrix unit; the row is labeled honestly and the
+    candidate-buffer identity is the platform-independent part.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tla_tpu.config import Bounds, ModelConfig
+    from raft_tla_tpu.cfg.parser import load_model
+    from raft_tla_tpu.engine.bfs import Engine
+    from raft_tla_tpu.engine.expand import Expander
+    from raft_tla_tpu.obs import Obs, SpanRecorder
+    from raft_tla_tpu.spec import get_spec
+    from raft_tla_tpu.spec.paxos.config import PaxosConfig
+
+    micro = ModelConfig(
+        n_servers=2, init_servers=(0, 1), values=(1,),
+        symmetry=True, max_inflight_override=4,
+        bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                           max_client_requests=1))
+    rows, counts = {}, {}
+    for label, dm in (("delta_matmul_off", False),
+                      ("delta_matmul_on", True)):
+        eng = Engine(micro, chunk=256, store_states=False,
+                     delta_matmul=dm)
+        rec = SpanRecorder()
+        obs = Obs(spans=rec)
+        with obs.span("compile"):
+            eng.check(max_depth=2)               # warm the jit caches
+        t0 = time.perf_counter()
+        r = eng.check(obs=obs)
+        secs = time.perf_counter() - t0
+        rows[label] = {
+            "distinct_states": int(r.distinct_states),
+            "depth": int(r.depth),
+            "delta_matmul": int(r.delta_matmul),
+            "seconds": round(secs, 2),
+            "states_per_sec": round(
+                r.distinct_states / max(secs, 1e-9), 1),
+            "phase_seconds": {nm: t["seconds"]
+                              for nm, t in rec.totals().items()},
+        }
+        counts[label] = (r.distinct_states, r.depth,
+                         tuple(r.level_sizes))
+    identical = counts["delta_matmul_on"] == counts["delta_matmul_off"]
+
+    # paxos end-to-end pair: declarations-only expansion, full space
+    pax_counts = {}
+    for label, dm in (("off", False), ("on", True)):
+        r = Engine(PaxosConfig(), chunk=128, store_states=False,
+                   delta_matmul=dm).check()
+        pax_counts[label] = (r.distinct_states, r.depth,
+                            tuple(r.level_sizes))
+    pax_identical = pax_counts["on"] == pax_counts["off"]
+
+    # ---- standalone expansion-phase micro-pair (config #2 lane mix) --
+    # the repo-local cfg twin + config #2's bounds reproduce the
+    # headline config's LANE GRID exactly; the batch is depth-limited
+    # reachable states (the phase timing needs the mix, not the space)
+    cfg2 = load_model(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "configs",
+        "tlc_membership", "raft.cfg"), bounds=Bounds.make(
+        max_log_length=3, max_timeouts=2, max_client_requests=3))
+    cfg2 = cfg2.with_(invariants=("ElectionSafety",))
+    ir = get_spec("raft")
+    lay = ir.make_layout(cfg2)
+    st = list(ir.oracle_explore(cfg2, max_states=1024,
+                                keep_states=True).states.values())[:256]
+    enc = [ir.encode(lay, sv, h) for sv, h in st]    # encode each ONCE
+    batch = ir.widen({k: np.stack([e[k] for e in enc])
+                      for k in enc[0]})
+    svT = {k: jnp.moveaxis(jnp.asarray(v), 0, -1)
+           for k, v in batch.items()}
+    ex_on = Expander(cfg2, delta_matmul=True)
+    ex_off = Expander(cfg2, delta_matmul=False)
+    derT = jax.jit(ex_on.derived_batch_T)(svT)
+    ok = np.asarray(jax.jit(ex_on.guards_T)(svT, derT))
+    B = ok.shape[0]
+    okf = jnp.asarray(ok.reshape(-1))
+    FCAP = int(ok.sum()) + 8
+    epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1, FCAP)
+    caps = ex_on.default_fam_caps(B)
+    f_on = jax.jit(lambda s, d: ex_on.materialize(
+        s, d, okf, epos, FCAP, caps))
+    f_off = jax.jit(lambda s, d: ex_off.materialize(
+        s, d, okf, epos, FCAP, caps))
+    c_on, _x1 = f_on(svT, derT)                  # warm + correctness
+    c_off, _x2 = f_off(svT, derT)
+    n_e = int(ok.sum())
+    cands_identical = all(
+        np.array_equal(np.asarray(c_on[k])[..., :n_e],
+                       np.asarray(c_off[k])[..., :n_e])
+        for k in c_on)
+    rec2 = SpanRecorder()
+    REPS = 10
+    with rec2.span("delta_apply"):
+        for _ in range(REPS):
+            f_on(svT, derT)[0]["ctr"].block_until_ready()
+    with rec2.span("delta_kernels"):
+        for _ in range(REPS):
+            f_off(svT, derT)[0]["ctr"].block_until_ready()
+    micro_phase = {nm: {"seconds": t["seconds"], "count": t["count"]}
+                   for nm, t in rec2.totals().items()}
+
+    plat = jax.default_backend()
+    ok_all = identical and pax_identical and cands_identical
+    out = {
+        "bench": "delta-matmul successor generation A/B with "
+                 "expansion-phase span totals (bench.py, BENCH_r11 "
+                 "round)",
+        "platform": plat,
+        "honest_label": (
+            "CPU-only fallback: this container has no TPU — the "
+            "count/candidate identities are platform-independent; the "
+            "delta_apply seconds time the off-TPU lowering (static "
+            "gathers + segment scatter-add, bit-identical buffers), "
+            "NOT the MXU einsum blocks a TPU runs"
+            if plat == "cpu" else "TPU-measured"),
+        "status": ("ok" if ok_all else
+                   "FAILED: delta-matmul path diverges from the "
+                   "kernel path — the perf rows are meaningless"),
+        "counts_identical": identical,
+        "paxos_counts_identical": pax_identical,
+        "paxos_zero_new_kernels": True,
+        "candidates_identical": cands_identical,
+        "delta_families_raft": list(ex_on.delta_family_names),
+        "rows": rows,
+        "expansion_phase_spans": micro_phase,
+        "expansion_phase_note": (
+            f"delta_apply/delta_kernels: {REPS} jitted materialize "
+            f"dispatches each over a 256-state reachable batch on "
+            f"config #2's lane mix ({ex_on.n_lanes} lanes, "
+            f"{n_e} enabled)"),
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, out_path)
+    return out
+
+
 def _batch_ab(out_path):
     """Multi-tenant batch A/B (BENCH round 10, ROADMAP 2b): K=4 small
     jobs — the same micro config under four different depth gates, the
@@ -487,6 +648,10 @@ def _no_reference_fallback():
     batch_ab = _batch_ab(os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "BENCH_r10.json"))
     gate_ok = gate_ok and batch_ab["status"] == "ok"
+    # round 11: the delta-matmul successor A/B rides the same gate
+    delta_ab = _delta_ab(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r11.json"))
+    gate_ok = gate_ok and delta_ab["status"] == "ok"
     print(json.dumps({
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
         "value": None, "unit": "states/sec", "vs_baseline": None,
@@ -514,7 +679,13 @@ def _no_reference_fallback():
                        "per_job_speedup": batch_ab["per_job_speedup"],
                        "engines_compiled": {
                            k: v["engines_compiled"]
-                           for k, v in batch_ab["rows"].items()}}}}))
+                           for k, v in batch_ab["rows"].items()}},
+                   "delta_ab": {
+                       "written_to": "BENCH_r11.json",
+                       "status": delta_ab["status"],
+                       "states_per_sec": {
+                           k: v["states_per_sec"]
+                           for k, v in delta_ab["rows"].items()}}}}))
 
 
 def main():
@@ -615,6 +786,9 @@ def main():
     batch_ab = _batch_ab(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_r10.json"))
     gate_ok = gate_ok and batch_ab["status"] == "ok"
+    delta_ab = _delta_ab(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r11.json"))
+    gate_ok = gate_ok and delta_ab["status"] == "ok"
 
     # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------
     # Only meaningful for the full-depth run on the recorded machine
@@ -664,6 +838,7 @@ def main():
         bool(burst_ab["counts_identical"])
     out["detail"]["matmul_ab_status"] = matmul_ab["status"]
     out["detail"]["batch_ab_status"] = batch_ab["status"]
+    out["detail"]["delta_ab_status"] = delta_ab["status"]
     print(json.dumps(out))
 
 

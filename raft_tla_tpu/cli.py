@@ -376,6 +376,7 @@ def cmd_check(args):
         burst_kw = dict(burst=args.burst, burst_levels=args.burst_levels,
                         guard_matmul=args.guard_matmul,
                         dedup_kernel=args.dedup_kernel,
+                        delta_matmul=args.delta_matmul,
                         fam_density=fam_density)
         if args.spill:
             # host-spill engine: levels stream through host RAM, for
@@ -559,7 +560,8 @@ def cmd_trace(args):
         return 0
     from .engine.bfs import Engine
     eng = Engine(cfg, chunk=args.chunk, store_states=True,
-                 guard_matmul=args.guard_matmul)
+                 guard_matmul=args.guard_matmul,
+                 delta_matmul=args.delta_matmul)
     r = eng.check(max_depth=args.max_depth, max_states=args.max_states,
                   stop_on_violation=True, verbose=args.verbose)
     if not r.violations:
@@ -607,7 +609,8 @@ def cmd_simulate(args):
     from .sim import SimEngine
     kw = dict(max_depth=depth, seed=args.seed, policy=args.policy,
               bloom_bits=args.bloom_bits,
-              guard_matmul=args.guard_matmul)
+              guard_matmul=args.guard_matmul,
+              delta_matmul=args.delta_matmul)
     if args.mesh and len(jax.local_devices()) > 1:
         from .parallel.sim_mesh import ShardedSimEngine
         eng = ShardedSimEngine(cfg, walkers=args.walkers, **kw)
@@ -700,7 +703,18 @@ def cmd_batch(args):
         print("no jobs: pass --jobs FILE.jsonl and/or --job JSON",
               file=sys.stderr)
         return 2
-    cache = ResultCache(args.cache_dir) if args.cache_dir else None
+    if args.cache_max_bytes is not None and args.cache_max_bytes <= 0:
+        print(f"--cache-max-bytes must be positive (got "
+              f"{args.cache_max_bytes}); omit it for an unbounded "
+              "cache", file=sys.stderr)
+        return 2
+    if args.cache_max_bytes is not None and not args.cache_dir:
+        print("--cache-max-bytes bounds the on-disk result cache: "
+              "add --cache-dir", file=sys.stderr)
+        return 2
+    cache = ResultCache(args.cache_dir,
+                        max_bytes=args.cache_max_bytes) \
+        if args.cache_dir else None
     obs = _build_obs(args)
     obs.start()
     done = False
@@ -793,6 +807,17 @@ def main(argv=None):
                              "as one-hot einsum blocks; --no-guard-"
                              "matmul restores the vmapped per-lane "
                              "sweep exactly")
+        sp.add_argument("--delta-matmul",
+                        action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="delta-matmul successor generation "
+                             "(default ON, bit-exact): families with "
+                             "declared delta algebras apply as ONE "
+                             "batched scatter-as-matmul per family "
+                             "group (int32 einsum blocks on the MXU); "
+                             "declaration-less families keep the "
+                             "per-family kernel path either way, and "
+                             "--no-delta-matmul restores it for all")
         sp.add_argument("--verbose", "-v", action="store_true")
 
     pc = sub.add_parser("check", help="exhaustive bounded check")
@@ -976,6 +1001,13 @@ def main(argv=None):
                          "result are answered with zero device "
                          "dispatches; results persist across "
                          "invocations")
+    pb.add_argument("--cache-max-bytes", type=int, default=None,
+                    metavar="N",
+                    help="LRU-by-bytes cache bound: every completed "
+                         "job's put trims the --cache-dir back under "
+                         "N bytes, least-recently-used payloads "
+                         "first (default: unbounded, the historical "
+                         "behavior)")
     pb.add_argument("--sequential", action="store_true",
                     help="run each job on its own engine instead of "
                          "the batched path (the honest A/B reference "

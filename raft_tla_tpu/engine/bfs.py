@@ -185,7 +185,7 @@ class CheckResult:
                  violations_global: int = 0, levels_fused: int = 0,
                  burst_dispatches: int = 0, burst_bailouts: int = 0,
                  pin_interior_states: int = 0, guard_matmul: int = 0,
-                 dedup_kernel: int = 0):
+                 dedup_kernel: int = 0, delta_matmul: int = 0):
         from ..obs.metrics import MetricsRegistry
         init = locals()
         self.metrics = MetricsRegistry()
@@ -431,6 +431,7 @@ class Engine:
                  archive_dir: Optional[str] = None,
                  guard_matmul: bool = True,
                  dedup_kernel: str = "auto",
+                 delta_matmul: bool = True,
                  fam_density: Optional[Dict[str, int]] = None):
         enable_persistent_compilation_cache()
         self.cfg = cfg
@@ -464,7 +465,14 @@ class Engine:
         # bit-exact by construction; guard_matmul=False restores the
         # historical vmapped-sweep program exactly
         self.guard_matmul = bool(guard_matmul)
-        self.expander = Expander(cfg, guard_matmul=self.guard_matmul)
+        # delta-matmul successor generation (expand.Expander docstring):
+        # families with declared delta algebras apply as ONE batched
+        # scatter-as-matmul per family group; default ON, bit-exact by
+        # construction, delta_matmul=False restores the per-family
+        # kernel path for every family
+        self.delta_matmul = bool(delta_matmul)
+        self.expander = Expander(cfg, guard_matmul=self.guard_matmul,
+                                 delta_matmul=self.delta_matmul)
         # Pallas probe/claim dedup kernel (fingerprint.py): "auto"
         # engages it on TPU only (the gather/scatter lax sequence stays
         # the CPU program — the kernel's interpret=True fallback exists
@@ -1493,6 +1501,9 @@ class Engine:
         resumed run reports the resuming engine's modes."""
         res.guard_matmul = int(self.guard_matmul)
         res.dedup_kernel = int(self._dedup_pallas)
+        # 1 only when the delta program actually compiled (flag ON and
+        # the spec declares at least one affine family)
+        res.delta_matmul = int(self.expander.delta_active)
         return res
 
     def _prewarm_perlevel(self):

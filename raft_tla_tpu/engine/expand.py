@@ -39,10 +39,35 @@ class Family:
     # without one fails at Expander construction (the int8 guard
     # matmul cannot silently fall back without forking the two paths).
     guard: Optional[Callable] = None
+    # delta-algebra declaration for the MXU successor path (the
+    # BLEST-style scatter-as-matmul; round 11): (offset table, layout,
+    # *lane params) -> [(slot, source, weight), ...] triples over the
+    # packed int32 state view, meaning
+    #
+    #     x'[slot] = x[slot] + sum over triples of weight * psi[source]
+    #
+    # where x is the flat int32 view of the state (u32 lanes bitcast)
+    # and psi = concat([1], x, kernels.delta_features(sv, der)).  A
+    # "set" is (slot, const, v) + (slot, old-slot source, -1); u32 bit
+    # sends ride a bit-clear/one-hot feature so integer add == set-OR.
+    # UNLIKE guard, delta is OPTIONAL: a family without one (genuinely
+    # nonlinear actions — bag inserts, log reshuffles) transparently
+    # keeps the per-family kernel path; declared families are compiled
+    # into ONE batched delta matmul per family group.
+    delta: Optional[Callable] = None
 
     @property
     def n_lanes(self):
         return len(self.params[0]) if self.params else 1
+
+
+def d_set(off, slot: int, value: int):
+    """Delta-declaration helper: the two triples of ``x'[slot] = value``
+    for a lane-constant value — the constant in, the old slot value
+    out.  (State- or feature-sourced sets are spelled directly as
+    triples; see the spec IRs.)"""
+    return [(slot, off["_const"], int(value)),
+            (slot, off["_src_x"] + slot, -1)]
 
 
 # Per-family enabled-lane density caps are part of the SpecIR contract
@@ -120,9 +145,20 @@ class Expander:
     ``materialize``/``step_lanes`` become one-hot einsum blocks (the
     BLEST/tensor-core-BFS formulation: frontier expansion as low-
     precision matrix products).  OFF restores the exact historical
-    gather/vmap program — tests/test_guard_matmul.py pins ON ≡ OFF."""
+    gather/vmap program — tests/test_guard_matmul.py pins ON ≡ OFF.
 
-    def __init__(self, cfg, guard_matmul: bool = True):
+    delta_matmul — the successor-GENERATION half of the same
+    reformulation (round 11): every family whose ``Family.delta``
+    algebra is declared compiles into shared one-hot delta matrices,
+    and ``materialize``/``step_lanes`` apply the whole affine family
+    group as ONE batched scatter-as-matmul (int32 einsum blocks:
+    S' = S + P^T((L Q) ⊙ (Ψ V)) over the packed int32 state view)
+    instead of one vmapped kernel per family.  Declaration-less
+    families transparently keep the kernel path; OFF restores it for
+    every family — tests/test_delta_matmul.py pins ON ≡ OFF."""
+
+    def __init__(self, cfg, guard_matmul: bool = True,
+                 delta_matmul: bool = True):
         self.cfg = cfg
         self.ir = spec_of(cfg)
         self.lay = self.ir.make_layout(cfg)
@@ -131,8 +167,28 @@ class Expander:
         self.keys = self.ir.all_keys
         self.n_lanes = sum(f.n_lanes for f in self.families)
         self.guard_matmul = bool(guard_matmul)
+        self.delta_matmul = bool(delta_matmul)
+        # P-contraction lowering: the MXU matmul on TPU, the
+        # bit-identical static scatter-add off-TPU (see _delta_of)
+        self._delta_mxu = jax.default_backend() == "tpu"
         self._gW, self._gT = self._build_guard_matrix()
+        self._dgroup = self._build_delta_group() if self.delta_matmul \
+            else None
         self._expand = jax.jit(self._expand_impl)
+
+    @property
+    def delta_active(self) -> bool:
+        """True when the delta-matmul successor path is compiled (the
+        flag is ON and at least one family declares its delta algebra)
+        — what the engines stamp into the ``delta_matmul`` counter."""
+        return self._dgroup is not None
+
+    @property
+    def delta_family_names(self):
+        if self._dgroup is None:
+            return ()
+        return tuple(self.families[fi].name
+                     for fi in self._dgroup["fam_idx"])
 
     # ---- packed guard matrix (the guard grid as int8 matmul) -------------
 
@@ -170,6 +226,205 @@ class Expander:
                 lane += 1
         assert lane == self.n_lanes
         return Wm, T
+
+    # ---- packed delta matrices (successor generation as matmul) ----------
+    #
+    # The affine family group compiles into three shared matrices over
+    # the flat int32 state view x (all state arrays in self.keys order,
+    # u32 lanes bitcast, row-major) and the extended source vector
+    # psi = concat([1], x, kernels.delta_features(sv, der)):
+    #
+    #   Q [A_g, T] int8  — triple-ownership: Q[a, t] = 1 iff triple t
+    #                      belongs to group lane a (kept as the
+    #                      documented matrix; _delta_of applies it as
+    #                      the equivalent static gather t_lane)
+    #   t_srcu/t_w [T]   — per-triple source row (into the pruned
+    #                      `used` psi subset) and int32 weight (u32
+    #                      bit weights wrap through two's complement,
+    #                      exact under the bit-clear sourcing
+    #                      contract) — the single-nonzero V matrix in
+    #                      gather form
+    #   P [T,   D] int8  — slot placement: P[t, slot_t] = 1
+    #
+    # so a compacted (row, lane) block with row one-hot R and lane
+    # one-hot L applies ALL its lanes' deltas as int32 einsum blocks:
+    #
+    #   x'_rows = R x + P^T ((L Q) ⊙ (w · psi[src]))
+    #
+    # — one batched scatter-as-matmul for the whole family group
+    # instead of one vmapped kernel per family (ROADMAP item 3, the
+    # BLEST formulation; arXiv:2512.21967 / 2606.05081).
+
+    def _build_delta_group(self):
+        fams = [(fi, fam) for fi, fam in enumerate(self.families)
+                if fam.delta is not None]
+        if not fams:
+            return None
+        # flat state-view layout from the spec's canonical (widened)
+        # encoding of the init state — shapes/dtypes only
+        proto = {k: np.asarray(v) for k, v in self.ir.widen(
+            self.ir.encode(self.lay,
+                           *self.ir.init_state(self.cfg))).items()}
+        slots, shapes, dtypes = {}, {}, {}
+        D = 0
+        for k in self.keys:
+            a = proto[k]
+            slots[k], shapes[k], dtypes[k] = D, a.shape, a.dtype
+            D += int(a.size)
+        foff = self.kern.delta_feature_offsets()
+        nF = int(foff["total"])
+        E = 1 + D + nF
+        OFF = dict(slots)
+        OFF["_const"] = 0            # source index of the literal 1
+        OFF["_src_x"] = 1            # + flat slot -> old-value source
+        OFF["_src_f"] = 1 + D        # + feature index -> feature source
+        OFF["_feat"] = dict(foff)    # the spec's feature offset table
+        t_lane, t_slot, t_src, t_w = [], [], [], []
+        fam_idx, lane_base = [], {}
+        lane_to_aff = np.full((self.n_lanes,), -1, np.int32)
+        A_g = 0
+        goff = 0                     # global lane offset
+        for fi, fam in enumerate(self.families):
+            nf = fam.n_lanes
+            if fam.delta is not None:
+                fam_idx.append(fi)
+                lane_base[fi] = A_g
+                lane_to_aff[goff:goff + nf] = \
+                    A_g + np.arange(nf, dtype=np.int32)
+                for li, vals in enumerate(
+                        zip(*fam.params) if fam.params else [()]):
+                    vals = tuple(int(v) for v in vals)
+                    for slot, src, w in fam.delta(OFF, self.lay, *vals):
+                        if not 0 <= slot < D:
+                            raise KeyError(
+                                f"delta declaration of family "
+                                f"{fam.name!r} (spec {self.ir.name!r}) "
+                                f"writes slot {slot} outside the "
+                                f"[0, {D}) state view")
+                        if not 0 <= src < E:
+                            raise KeyError(
+                                f"delta declaration of family "
+                                f"{fam.name!r} (spec {self.ir.name!r}) "
+                                f"reads source {src} outside the "
+                                f"[0, {E}) psi vector")
+                        if not -(1 << 31) <= int(w) < (1 << 32):
+                            # the deliberate wrap below covers u32 bit
+                            # weights; anything wider would silently
+                            # truncate — fail at build time instead
+                            raise KeyError(
+                                f"delta declaration of family "
+                                f"{fam.name!r} (spec {self.ir.name!r}) "
+                                f"uses weight {w} outside the 32-bit "
+                                f"range")
+                        t_lane.append(A_g + li)
+                        t_slot.append(slot)
+                        t_src.append(src)
+                        t_w.append(int(w))
+                A_g += nf
+            goff += nf
+        T = len(t_w)
+        Q = np.zeros((A_g, T), np.int8)
+        Q[np.asarray(t_lane), np.arange(T)] = 1
+        # prune the source axis to the USED psi rows only: V holds one
+        # nonzero per column, so restricting to the distinct sources
+        # (typically tens, vs E = 1 + D + n_features in the hundreds)
+        # shrinks both the traced graph and the matmul FLOPs several-
+        # fold with zero semantic change — `used` gathers the rows out
+        # of the full psi vector with static indices
+        used = np.unique(np.asarray(t_src, np.int64))
+        src_of = {int(s): u for u, s in enumerate(used)}
+        # u32-bit weights (1 << 31) wrap to INT_MIN: two's-complement
+        # add still sets exactly that bit when the source proves it
+        # clear, so the wrap is the intended exact arithmetic
+        t_wi = (np.asarray(t_w, np.int64) &
+                0xFFFFFFFF).astype(np.uint32).view(np.int32)
+        P = np.zeros((T, D), np.int8)
+        P[np.arange(T), np.asarray(t_slot)] = 1
+        return dict(fam_idx=fam_idx, lane_base=lane_base, n_lanes=A_g,
+                    n_triples=T, Q=Q, P=P, slots=slots,
+                    shapes=shapes, dtypes=dtypes, D=D,
+                    used=used.astype(np.int32), n_feats=nF,
+                    t_lane=np.asarray(t_lane, np.int32),
+                    t_srcu=np.asarray([src_of[s] for s in t_src],
+                                      np.int32),
+                    t_slot=np.asarray(t_slot, np.int32),
+                    t_w=t_wi, lane_to_aff=lane_to_aff)
+
+    def _flatten_T(self, svT) -> jnp.ndarray:
+        """Batch-last state dict [..., B] -> flat int32 view [D, B]
+        (u32 lanes bitcast; key order = self.keys, row-major)."""
+        parts = []
+        for k in self.keys:
+            v = svT[k]
+            if v.dtype == jnp.uint32:
+                v = jax.lax.bitcast_convert_type(v, jnp.int32)
+            parts.append(v.reshape((-1,) + v.shape[-1:]))
+        return jnp.concatenate(parts, axis=0)
+
+    def _unflatten_T(self, flat):
+        """[D, B] flat view -> the state dict, original shapes/dtypes."""
+        dg = self._dgroup
+        out, pos = {}, 0
+        for k in self.keys:
+            shape = dg["shapes"][k]
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            v = flat[pos:pos + n].reshape(tuple(shape) + flat.shape[-1:])
+            if dg["dtypes"][k] == np.uint32:
+                v = jax.lax.bitcast_convert_type(v, jnp.uint32)
+            out[k] = v
+            pos += n
+        return out
+
+    def _delta_of(self, psi_c, selL):
+        """The group delta [D, cap] for per-row sources psi_c [U, cap]
+        and group-lane one-hots selL [cap, A_g]: per-triple terms
+        ``own ⊙ (w · psi[src])`` contract against the slot-placement
+        matrix P — the scatter-as-matmul (an all-zero selL row applies
+        no delta, so the row passes through unchanged).
+
+        The per-triple source/ownership selections are single-nonzero
+        matrices, so they apply as STATIC-index gathers (free to
+        compile, and on TPU they vectorize as row broadcasts).  P's
+        contraction is the one genuine summation: on TPU it is the
+        int32 matmul that rides the MXU; off-TPU it lowers to the
+        bit-identical static segment scatter-add (int32 addition is
+        commutative/associative even under wrap, so the two lowerings
+        produce equal buffers) — ANY dot embedded in the fused engine
+        step costs ~1.3s of XLA:CPU compile per traced program, which
+        tier-1 pays per engine instance (same fallback posture as the
+        Pallas dedup kernel's interpret mode)."""
+        dg = self._dgroup
+        tv = psi_c[jnp.asarray(dg["t_srcu"])] * \
+            jnp.asarray(dg["t_w"])[:, None]               # [T, cap]
+        own = jnp.transpose(selL)[jnp.asarray(dg["t_lane"])]
+        x = own * tv
+        if self._delta_mxu:
+            return jnp.einsum("td,tc->dc", jnp.asarray(dg["P"]), x,
+                              preferred_element_type=jnp.int32)
+        slots = jnp.asarray(dg["t_slot"])
+        return jnp.zeros((dg["D"], x.shape[-1]),
+                         jnp.int32).at[slots].add(x)
+
+    def _psi_T(self, svT, derT, xflat):
+        """The USED rows of the extended source vector
+        psi = [1; x; features], in `used` (ascending-source) order —
+        [U, B].  Regions are gathered with static indices; the feature
+        pass is skipped entirely when no declaration sources it."""
+        dg = self._dgroup
+        used, D = dg["used"], dg["D"]
+        B = xflat.shape[-1]
+        u_x = used[(used >= 1) & (used < 1 + D)] - 1
+        u_f = used[used >= 1 + D] - (1 + D)
+        parts = []
+        if (used < 1).any():
+            parts.append(jnp.ones((1, B), jnp.int32))
+        if len(u_x):
+            parts.append(xflat[jnp.asarray(u_x)])
+        if len(u_f):
+            feats = jax.vmap(self.kern.delta_features,
+                             in_axes=-1, out_axes=-1)(svT, derT)
+            parts.append(feats.astype(jnp.int32)[jnp.asarray(u_f)])
+        return jnp.concatenate(parts, axis=0)
 
     def lane_labels(self) -> List[str]:
         out = []
@@ -337,11 +592,13 @@ class Expander:
         blk_start = np.empty((n_fams,), np.int64)    # grouped offsets
         caps_np = np.asarray(fam_caps, np.int32)
         coff_np = np.concatenate([[0], np.cumsum(caps_np)[:-1]])
+        fam_off = []                  # global lane offset per family
         g = 0
         off = 0
         for fi, fam in enumerate(self.families):
             nf = fam.n_lanes
             blk_start[fi] = g
+            fam_off.append(off)
             bl = (np.arange(B)[:, None] * A + off +
                   np.arange(nf)[None, :]).reshape(-1)
             perm[g:g + B * nf] = bl
@@ -375,6 +632,81 @@ class Expander:
             jnp.where(fits, epos_g, fcap)].set(
             target, mode="drop")
 
+        # ---- affine family group: ONE batched scatter-as-matmul ------
+        # Every delta-declared family's buffer slice concatenates into
+        # a single (row, group-lane) block; parent-row selection, the
+        # source gather and the slot scatter all run as int32 einsum
+        # blocks over the flat state view (the BLEST reformulation —
+        # see the delta-matrix comment above).  Declaration-less
+        # families fall through to the per-family kernel loop below.
+        dg = self._dgroup
+        g_cand = None
+        if dg is not None:
+            with jax.named_scope("delta_apply"):
+                gb_parts, gl_parts = [], []
+                for fi in dg["fam_idx"]:
+                    nf = self.families[fi].n_lanes
+                    lo = int(coff_np[fi])
+                    cap = fam_caps[fi]
+                    gb_parts.append(b_all[lo:lo + cap])
+                    gl_parts.append(jnp.clip(
+                        l_all[lo:lo + cap] - fam_off[fi], 0, nf - 1)
+                        + dg["lane_base"][fi])
+                # barrier the block's inputs as well as its output:
+                # the compaction indices and the flat/psi views
+                # otherwise fuse into the one-hot einsums and the
+                # fusion search dominates compile time (~1.3s per
+                # traced program on XLA:CPU) — identity ops, bit-exact
+                gb, gl = jax.lax.optimization_barrier(
+                    (jnp.concatenate(gb_parts),
+                     jnp.concatenate(gl_parts)))
+                xflat = jax.lax.optimization_barrier(
+                    self._flatten_T(svT))
+                psi = jax.lax.optimization_barrier(
+                    self._psi_T(svT, derT, xflat))
+                selL = (gl[:, None] ==
+                        jnp.arange(dg["n_lanes"],
+                                   dtype=jnp.int32)[None, :]) \
+                    .astype(jnp.int32)                    # [gcap, A_g]
+                if self._delta_mxu:
+                    # row selection as one-hot matmuls (the PR-8
+                    # _sel_rows trick, whole group at once)
+                    selB = (gb[:, None] ==
+                            jnp.arange(B, dtype=jnp.int32)[None, :]) \
+                        .astype(jnp.int32)                # [gcap, B]
+                    rows_flat = jnp.einsum(
+                        "db,cb->dc", xflat, selB,
+                        preferred_element_type=jnp.int32)
+                    vals = jnp.einsum(
+                        "eb,cb->ec", psi, selB,
+                        preferred_element_type=jnp.int32)
+                else:
+                    # off-TPU: the bit-identical column gather (each
+                    # embedded dot costs ~1s of XLA:CPU compile)
+                    rows_flat = xflat[:, gb]
+                    vals = psi[:, gb]
+                # the barrier stops XLA fusing the delta matmul into
+                # its ~n_keys × n_families unflatten/concat consumers —
+                # without it the fusion search costs ~1.3s of compile
+                # per traced program (same class as the phase barriers
+                # in engine/bfs._chunk_step_impl); identity, so the
+                # bit-exactness contract is untouched
+                out_flat = jax.lax.optimization_barrier(
+                    rows_flat + self._delta_of(vals, selL))
+                # ONE unflatten for the whole group buffer; families
+                # slice their column ranges out of the shaped arrays
+                # (slices are far cheaper to trace than per-family
+                # reshape+bitcast cascades)
+                g_all = self._unflatten_T(out_flat)
+                g_par = (self._unflatten_T(rows_flat)
+                         if delta_fp is not None else None)
+                g_pos = {}
+                pos = 0
+                for fi in dg["fam_idx"]:
+                    g_pos[fi] = pos
+                    pos += fam_caps[fi]
+                g_cand = g_pos            # membership + slice offset
+
         # ---- per-family successor kernels on their buffer slices -----
         outs = []
         fp_outs = []
@@ -384,6 +716,26 @@ class Expander:
             lo = int(coff_np[fi])
             b_idx = b_all[lo:lo + cap]
             l_idx = jnp.clip(l_all[lo:lo + cap] - off, 0, nf - 1)
+            if g_cand is not None and fi in g_cand:
+                # affine family: its successors came out of the group
+                # delta matmul above; only the incremental-fp hook
+                # still needs the per-family row/param views
+                gp = g_cand[fi]
+                sv2 = {k: v[..., gp:gp + cap]
+                       for k, v in g_all.items()}
+                outs.append(sv2)
+                if delta_fp is not None:
+                    prm_rows = (self._sel_params(fam.params, l_idx, nf)
+                                if self.guard_matmul else
+                                [jnp.asarray(p)[l_idx]
+                                 for p in fam.params])
+                    fpr, tables = delta_fp
+                    fp_outs.append(fpr.family_delta(
+                        fam.name, tables, b_idx,
+                        {k: v[..., gp:gp + cap]
+                         for k, v in g_par.items()}, sv2, prm_rows))
+                off += nf
+                continue
             if self.guard_matmul:
                 # batched successor einsum: the family's compacted
                 # (row, lane) block selects parent rows and lane params
@@ -431,11 +783,35 @@ class Expander:
         successor rows [..., B].  lane must be an enabled lane of its
         state (sim samples from guards_T via ops.kernels.select_enabled);
         rows whose lane is out of range (e.g. -1 = no enabled lane)
-        return the state unchanged — callers mask on enabled-count."""
-        out = {k: v for k, v in svT.items()}
+        return the state unchanged — callers mask on enabled-count.
+
+        With the delta path compiled, every walker whose lane belongs
+        to an affine family steps through ONE group delta matmul (a
+        walker outside the group gets an all-zero lane one-hot, so its
+        delta is exactly zero and the row passes through); only the
+        declaration-less families still apply their kernels."""
+        dg = self._dgroup
+        if dg is not None:
+            with jax.named_scope("delta_apply"):
+                aff = jnp.asarray(dg["lane_to_aff"])[
+                    jnp.clip(lane, 0, self.n_lanes - 1)]
+                aff = jnp.where(lane >= 0, aff, jnp.int32(-1))
+                selL = (aff[:, None] ==
+                        jnp.arange(dg["n_lanes"],
+                                   dtype=jnp.int32)[None, :]) \
+                    .astype(jnp.int32)                    # [B, A_g]
+                xflat = self._flatten_T(svT)
+                psi = self._psi_T(svT, derT, xflat)
+                out = self._unflatten_T(
+                    xflat + self._delta_of(psi, selL))
+        else:
+            out = {k: v for k, v in svT.items()}
         off = 0
         for fam in self.families:
             nf = fam.n_lanes
+            if dg is not None and fam.delta is not None:
+                off += nf
+                continue
             li = jnp.clip(lane - off, 0, nf - 1)
             prm = (self._sel_params(fam.params, li, nf)
                    if self.guard_matmul

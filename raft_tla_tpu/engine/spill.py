@@ -99,6 +99,7 @@ class SpillEngine(Engine):
                  archive_dir: Optional[str] = None,
                  guard_matmul: bool = True,
                  dedup_kernel: str = "auto",
+                 delta_matmul: bool = True,
                  fam_density: Optional[Dict[str, int]] = None):
         # burst (fused multi-level dispatch) is ON by default since
         # round 8 — the tiny early levels of a deep spill run pay the
@@ -111,6 +112,7 @@ class SpillEngine(Engine):
                          archive_dir=archive_dir,
                          guard_matmul=guard_matmul,
                          dedup_kernel=dedup_kernel,
+                         delta_matmul=delta_matmul,
                          fam_density=fam_density)
         self.SEGL = self.LCAP          # level segment rows (can grow)
         self.SEGF = self.LCAP          # frontier segment rows (fixed)

@@ -31,13 +31,15 @@ CHECK_COUNTER_KEYS = (
     "distinct_states", "generated_states", "depth", "overflow_faults",
     "violations_global", "levels_fused", "burst_dispatches",
     "burst_bailouts", "pin_interior_states", "guard_matmul",
-    "dedup_kernel")
+    "dedup_kernel", "delta_matmul")
 
 # the MXU-path mode flags (0/1): which expansion/dedup program this
-# run executed — BENCH round 9 reads these next to the guard_matmul /
-# dedup_kernel span totals so the A/B attributes per phase AND records
-# which mode produced each row
-MXU_COUNTER_KEYS = ("guard_matmul", "dedup_kernel")
+# run executed — BENCH rounds 9/11 read these next to the
+# guard_matmul / dedup_kernel / delta_apply span totals so the A/B
+# attributes per phase AND records which mode produced each row.
+# Stamped LIVE by every engine's _stamp_mode (never serialized into
+# checkpoints — a resumed run reports the resuming engine's modes).
+MXU_COUNTER_KEYS = ("guard_matmul", "dedup_kernel", "delta_matmul")
 
 # the burst telemetry triple that must agree between the ledger,
 # --stats-json and checkpoint meta (the PR-5 drift class)

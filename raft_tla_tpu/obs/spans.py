@@ -4,10 +4,12 @@ Every engine driver brackets its phases — ``compile``,
 ``burst_dispatch``, ``level_dispatch``, ``host_sweep``, ``harvest``,
 ``archive_io``, ``checkpoint`` — with ``SpanRecorder.span(name)``.
 Round 9 adds the MXU-path micro-phase names ``guard_matmul`` /
-``guard_lanes`` and ``dedup_kernel`` / ``dedup_probe``: inside a fused
-engine step these exist as ``jax.named_scope`` annotations (visible in
-an XLA ``--profile-dir`` trace), and bench.py times them as standalone
-host spans in the BENCH_r09 A/B so the win attributes per phase.
+``guard_lanes`` and ``dedup_kernel`` / ``dedup_probe``; round 11 adds
+``delta_apply`` / ``delta_kernels`` (the group scatter-as-matmul vs
+the per-family successor kernels): inside a fused engine step these
+exist as ``jax.named_scope`` annotations (visible in an XLA
+``--profile-dir`` trace), and bench.py times them as standalone host
+spans in the BENCH_r09/r11 A/Bs so the win attributes per phase.
 Clocks are ``time.perf_counter()`` (monotonic: NTP steps on long
 tunneled runs corrupted the old ``time.time()`` deltas), and completed
 spans are emitted as Chrome-trace "complete" events (``ph": "X"`` with

@@ -853,6 +853,60 @@ class RaftKernels:
             leader, cand, folc, blq, cfgb.reshape(-1), nv.reshape(-1),
             ut, cocd, recv, cnt1]).astype(jnp.int8)
 
+    # ------------------------------------------------------------------
+    # Delta features (the value-source half of the delta-matmul
+    # successor path, engine/expand delta-matrix comment; round 11).
+    #
+    # Every affine family's state delta is a weighted sum of these
+    # per-state int32 sources (plus the constant 1 and the flat state
+    # view itself), so successor generation for the declared families
+    # runs as one batched scatter-as-matmul.  The features fold the
+    # few data-dependent pieces the raft affine actions need:
+    #
+    # - BecomeLeader's three feat-lane max-updates, pre-differenced
+    #   (max(old, x) - old), so the matmul ADD lands the max exactly;
+    # - Timeout's term-capacity clamp (ct < cap room / its overflow);
+    # - ClientRequest's append machinery: the one-hot of the append
+    #   position (llen), the same one-hot scaled by the entry's term
+    #   and by the old log word (so set == add with the old value
+    #   cancelled), and the llen-room / overflow flags.
+    #
+    # Layout is ``delta_feature_offsets`` below; the two must move
+    # together (same single-definition rule as guard_features).
+    # ------------------------------------------------------------------
+
+    def delta_features(self, sv: State, der: State) -> jnp.ndarray:
+        S, Lcap = self.S, self.Lcap
+        feat = sv["feat"]
+        ii = jnp.arange(S)
+        # BecomeLeader feat deltas, per candidate server i
+        leaders2 = der["leaders"] | (jnp.int32(1) << ii)
+        bl2 = (popcount(leaders2, S) >= 2).astype(jnp.int32)
+        d_bl2 = jnp.maximum(feat[F_BL2_SEEN], bl2) - feat[F_BL2_SEEN]
+        njbl = (feat[F_ADDED_SET] >> ii) & 1
+        d_njbl = jnp.maximum(feat[F_NJBL], njbl) - feat[F_NJBL]
+        d_lcdcc = (jnp.maximum(feat[F_LCDCC], feat[F_OPEN_ADD]) -
+                   feat[F_LCDCC])[None]
+        # Timeout's clamped term bump: room == the exact increment
+        cap = self.cfg.bounds.max_terms + 1
+        ctroom = (sv["ct"] < cap).astype(jnp.int32)
+        # ClientRequest append: llen room + the append-position one-hot
+        crroom = (sv["llen"] < Lcap).astype(jnp.int32)
+        pos = jnp.arange(Lcap, dtype=jnp.int32)
+        croh = (sv["llen"][:, None] == pos[None, :]) \
+            .astype(jnp.int32)                            # [S, Lcap]
+        crohct = croh * sv["ct"][:, None]
+        crohold = croh * sv["log"]
+        return jnp.concatenate([
+            d_bl2, d_njbl, d_lcdcc, ctroom, crroom,
+            croh.reshape(-1), crohct.reshape(-1),
+            crohold.reshape(-1)]).astype(jnp.int32)
+
+    def delta_feature_offsets(self) -> Dict[str, int]:
+        """The SpecIR kernels contract: flat layout of this spec's
+        ``delta_features`` vector (module-level table below)."""
+        return delta_feature_offsets(self.lay)
+
 
 def guard_feature_offsets(lay: Layout) -> Dict[str, int]:
     """Flat layout of ``RaftKernels.guard_features``: per-server role
@@ -869,4 +923,19 @@ def guard_feature_offsets(lay: Layout) -> Dict[str, int]:
     off.update(ut=base, cocd=base + K, recv=base + 2 * K,
                cnt1=base + 3 * K)
     off["total"] = base + 4 * K
+    return off
+
+
+def delta_feature_offsets(lay: Layout) -> Dict[str, int]:
+    """Flat layout of ``RaftKernels.delta_features``: the BecomeLeader
+    feat-delta blocks (bl2 / njbl per server, the scalar lcdcc), the
+    Timeout term-room block, then the ClientRequest append blocks
+    (llen room, and the three [S, Lcap] one-hot grids: position,
+    position × term, position × old log word)."""
+    S, Lcap = lay.S, lay.Lcap
+    off = dict(bl2=0, njbl=S, lcdcc=2 * S, ctroom=2 * S + 1,
+               crroom=3 * S + 1, croh=4 * S + 1,
+               crohct=4 * S + 1 + S * Lcap,
+               crohold=4 * S + 1 + 2 * S * Lcap)
+    off["total"] = 4 * S + 1 + 3 * S * Lcap
     return off

@@ -117,6 +117,7 @@ class ShardedEngine(Engine):
                  burst_levels: Optional[int] = None,
                  guard_matmul: bool = True,
                  dedup_kernel: str = "auto",
+                 delta_matmul: bool = True,
                  fam_density=None):
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(np.array(devices), axis_names=("d",))
@@ -129,6 +130,7 @@ class ShardedEngine(Engine):
                          burst_levels=burst_levels,
                          guard_matmul=guard_matmul,
                          dedup_kernel=dedup_kernel,
+                         delta_matmul=delta_matmul,
                          fam_density=fam_density)
         # the sharded step computes full per-candidate fingerprints: the
         # incremental per-action path (engine/fingerprint) is not wired

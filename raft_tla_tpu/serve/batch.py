@@ -250,17 +250,21 @@ class BucketEngine:
     so the solo executables are never traced or compiled here."""
 
     def __init__(self, cfg, chunk: int = 128, vcap: int = 1 << 15,
-                 burst_levels: int = 8):
+                 burst_levels: int = 8, delta_matmul: bool = True):
         from ..engine.bfs import Engine
         # dedup_kernel="off": the Pallas probe kernel has no batching
         # rule; the lax claim walk is bit-identical in every mode
         # (tests/test_guard_matmul.py pins it), so the batched program
         # loses nothing but a TPU micro-optimization.  store_states
         # stays off on the engine — serve harvests its own per-job
-        # archives straight from the burst outputs.
+        # archives straight from the burst outputs.  delta_matmul
+        # vmaps cleanly (pure einsum blocks), so the batched program
+        # keeps the group delta path; the kwarg exists for A/B tests
+        # (bucket_overrides={"delta_matmul": False}).
         self.eng = Engine(cfg, chunk=chunk, store_states=False,
                           vcap=vcap, dedup_kernel="off",
-                          burst_levels=burst_levels)
+                          burst_levels=burst_levels,
+                          delta_matmul=delta_matmul)
         self.KB = self.eng._burst_width()
         self.VCAP = self.eng.VCAP
         self._fn = self.eng.burst_batched_fn()

@@ -176,7 +176,8 @@ class SimEngine:
                  policy: str = "punctuated",
                  traj_cap: Optional[int] = None,
                  bloom_bits: int = 22, wid_base: int = 0,
-                 guard_matmul: bool = True):
+                 guard_matmul: bool = True,
+                 delta_matmul: bool = True):
         enable_persistent_compilation_cache()
         if policy not in ("punctuated", "tlc"):
             raise ValueError(f"unknown restart policy {policy!r}")
@@ -197,8 +198,14 @@ class SimEngine:
         # guards_T becomes the int8 matmul, step_lanes' per-walker
         # param selection the one-hot einsum — trajectories are
         # bit-identical either way (tests/test_guard_matmul.py)
+        # the delta-matmul successor path drops into step_lanes the
+        # same way: affine-family walkers step through ONE group delta
+        # matmul; trajectories are bit-identical either way
+        # (tests/test_delta_matmul.py)
         self.guard_matmul = bool(guard_matmul)
-        self.expander = Expander(cfg, guard_matmul=self.guard_matmul)
+        self.delta_matmul = bool(delta_matmul)
+        self.expander = Expander(cfg, guard_matmul=self.guard_matmul,
+                                 delta_matmul=self.delta_matmul)
         fp_cfg = cfg
         self.bloom_canonical = True
         if cfg.symmetry:

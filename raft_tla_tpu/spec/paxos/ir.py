@@ -26,8 +26,20 @@ FAMILY_DENSITY = {
 }
 
 
+def _send_bit(off, idx, src=None):
+    """Delta triples for the monotone bit-send ``msgs |= 1 << idx``:
+    the bit's weight rides its own bit-clear feature (``src`` overrides
+    the source — Phase1b routes through the (mbal, mval) one-hot), so
+    the int32 add IS the set-OR, exactly (the 1<<31 lane wraps through
+    two's complement — engine/expand builds the matrix with the wrap)."""
+    if src is None:
+        src = off["_src_f"] + off["_feat"]["notbit"] + idx
+    return [(off["msgs"] + (idx >> 5), src, 1 << (idx & 31))]
+
+
 def build_families(lay) -> List["Family"]:
-    from ...engine.expand import Family
+    from .. import C_GLOBLEN
+    from ...engine.expand import Family, d_set
     from .kernels import PaxosKernels
     kern = PaxosKernels(lay)
     I, N, B, V = lay.I, lay.N, lay.B, lay.V
@@ -37,28 +49,74 @@ def build_families(lay) -> List["Family"]:
                            indexing="ij")
         return tuple(a.ravel() for a in arrs)
 
+    # ---- delta-algebra declarations: every Paxos action is
+    # slot-affine (set-monotone sends + per-cell scalar sets), so the
+    # whole spec's expansion runs as the group delta matmul with ZERO
+    # per-family kernels — the "new spec gets vectorized expansion
+    # from its declarations alone" proof (ROADMAP item 3).
+
+    def glob(off):
+        return [(off["ctr"] + C_GLOBLEN, off["_const"], 1)]
+
+    def d_1a(off, lay, i, b):
+        return _send_bit(off, lay.off_1a + i * lay.B + b) + glob(off)
+
+    def d_1b(off, lay, i, a, b):
+        P = (lay.B + 1) * (lay.V + 1)
+        base = lay.off_1b + ((i * lay.N + a) * lay.B + b) * P
+        mb = off["mb"] + i * lay.N + a
+        tr = [(mb, off["_const"], b), (mb, off["_src_x"] + mb, -1)]
+        # the report bit position depends on (vb, vv): spread the send
+        # over the (mbal, mval) one-hot block — exactly one position
+        # fires, and monotone-mb means the bit is provably clear
+        fsel = off["_src_f"] + off["_feat"]["sel1b"] \
+            + (i * lay.N + a) * P
+        for p in range(P):
+            tr += _send_bit(off, base + p, src=fsel + p)
+        return tr + glob(off)
+
+    def d_2a(off, lay, i, b, v):
+        return _send_bit(
+            off, lay.off_2a + (i * lay.B + b) * lay.V + v) + glob(off)
+
+    def d_2b(off, lay, i, a, b, v):
+        mb = off["mb"] + i * lay.N + a
+        vb = off["vb"] + i * lay.N + a
+        vv = off["vv"] + i * lay.N + a
+        return (d_set(off, mb, b) + d_set(off, vb, b) +
+                d_set(off, vv, v) +
+                # a re-accept's bit is already set: notbit sourcing
+                # makes the add a no-op there, exactly the set-OR
+                _send_bit(off, lay.off_2b +
+                          ((i * lay.N + a) * lay.B + b) * lay.V + v) +
+                glob(off))
+
     return [
         Family("Phase1a", kern.phase1a, grid(range(I), range(B)),
                lambda i, b: f"Phase1a({i},{b})",
                guard=lambda off, lay, i, b: (
-                   [(off["p1a"] + i * lay.B + b, 1)], 1)),
+                   [(off["p1a"] + i * lay.B + b, 1)], 1),
+               delta=d_1a),
         Family("Phase1b", kern.phase1b,
                grid(range(I), range(N), range(B)),
                lambda i, a, b: f"Phase1b({i},{a},{b})",
                guard=lambda off, lay, i, a, b: (
-                   [(off["p1b"] + (i * lay.N + a) * lay.B + b, 1)], 1)),
+                   [(off["p1b"] + (i * lay.N + a) * lay.B + b, 1)], 1),
+               delta=d_1b),
         Family("Phase2a", kern.phase2a,
                grid(range(I), range(B), range(V)),
                lambda i, b, v: f"Phase2a({i},{b},{v})",
                guard=lambda off, lay, i, b, v: (
-                   [(off["p2a"] + (i * lay.B + b) * lay.V + v, 1)], 1)),
+                   [(off["p2a"] + (i * lay.B + b) * lay.V + v, 1)], 1),
+               delta=d_2a),
         Family("Phase2b", kern.phase2b,
                grid(range(I), range(N), range(B), range(V)),
                lambda i, a, b, v: f"Phase2b({i},{a},{b},{v})",
                guard=lambda off, lay, i, a, b, v: (
                    [(off["p2b"] +
                      ((i * lay.N + a) * lay.B + b) * lay.V + v, 1)],
-                   1)),
+                   1),
+               delta=d_2b),
     ]
 
 

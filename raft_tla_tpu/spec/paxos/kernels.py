@@ -132,6 +132,40 @@ class PaxosKernels:
         return off
 
     # ------------------------------------------------------------------
+    # Delta features (the delta-matmul successor path; engine/expand).
+    #
+    # Paxos needs exactly two source blocks to make EVERY action
+    # slot-affine (ir.py declares all four families, so expansion runs
+    # with zero per-family kernels):
+    #
+    # - ``notbit`` — 1 - bits over the whole message universe: sourcing
+    #   a bit-send's weight (1 << bit) through the bit's own clearness
+    #   makes the int32 add exactly the monotone set-OR, even on
+    #   re-accept lanes (Phase2b) whose message is already present;
+    # - ``sel1b`` — per (i, a), the one-hot over the (B+1)(V+1)
+    #   (mbal, mval) report positions selected by the acceptor's
+    #   current (vb, vv): Phase1b's message bit is the one
+    #   data-dependent slot in the whole spec.
+    # ------------------------------------------------------------------
+
+    def delta_features(self, sv: State, der: State) -> jnp.ndarray:
+        V = self.V
+        notbit = 1 - der["bits"]                   # [n_msg_bits]
+        P = (self.B + 1) * (V + 1)
+        p = (sv["vb"] + 1) * (V + 1) + (sv["vv"] + 1)      # [I, N]
+        sel1b = (p[:, :, None] ==
+                 jnp.arange(P, dtype=jnp.int32)[None, None, :]) \
+            .astype(jnp.int32)                     # [I, N, P]
+        return jnp.concatenate(
+            [notbit, sel1b.reshape(-1)]).astype(jnp.int32)
+
+    def delta_feature_offsets(self) -> Dict[str, int]:
+        P = (self.B + 1) * (self.V + 1)
+        off = dict(notbit=0, sel1b=self.lay.n_msg_bits)
+        off["total"] = self.lay.n_msg_bits + self.I * self.N * P
+        return off
+
+    # ------------------------------------------------------------------
     # Action kernels (oracle twins in model.py, cited per kernel)
     # ------------------------------------------------------------------
 

@@ -42,7 +42,10 @@ from . import SpecIR
 # ---------------------------------------------------------------------------
 
 def build_families(lay) -> List["Family"]:
-    from ..engine.expand import Family
+    from ..config import CANDIDATE, LEADER, NIL, VALUE_ENTRY
+    from ..engine.expand import Family, d_set
+    from ..ops.codec import (C_GLOBLEN, C_NLEADERS, C_NREQ, C_OVERFLOW,
+                             F_BL2_SEEN, F_LCDCC, F_NJBL)
     from ..ops.kernels import RaftKernels
     cfg = lay.cfg
     kern = RaftKernels(lay)
@@ -60,6 +63,82 @@ def build_families(lay) -> List["Family"]:
     i_ = grid(range(S))
     k_ = grid(range(K))
 
+    # ---- delta-algebra declarations (the scatter-as-matmul successor
+    # path, engine/expand delta-matrix comment).  The slot-affine
+    # majority declares its writes as (slot, source, weight) triples
+    # over the flat int32 state view; the data-dependent pieces ride
+    # the kernels' delta_features (ops/kernels.delta_feature_offsets).
+    # Bag inserts (RequestVote/AppendEntries/...), the Receive branch
+    # family, Restart's min-gap feature and AdvanceCommitIndex's
+    # quorum/prefix scan are genuinely nonlinear — they declare NO
+    # delta and transparently keep the per-family kernel path.
+
+    def d_timeout(off, lay, i):
+        F, FS = off["_feat"], off["_src_f"]
+        X, C = off["_src_x"], off["_const"]
+        return (
+            d_set(off, off["st"] + i, CANDIDATE) +
+            # ct' = min(ct+1, cap): the room feature IS the increment
+            [(off["ct"] + i, FS + F["ctroom"] + i, 1)] +
+            d_set(off, off["vf"] + i, NIL) +
+            [(off["vr"] + i, X + off["vr"] + i, -1),
+             (off["vg"] + i, X + off["vg"] + i, -1),
+             (off["timeout"] + i, C, 1),
+             # overflow = 1 - room
+             (off["ctr"] + C_OVERFLOW, C, 1),
+             (off["ctr"] + C_OVERFLOW, FS + F["ctroom"] + i, -1),
+             (off["ctr"] + C_GLOBLEN, C, 1)])
+
+    def d_become_leader(off, lay, i):
+        F, FS = off["_feat"], off["_src_f"]
+        X, C = off["_src_x"], off["_const"]
+        tr = d_set(off, off["st"] + i, LEADER)
+        for j in range(lay.S):
+            nij = off["ni"] + i * lay.S + j
+            mij = off["mi"] + i * lay.S + j
+            # ni' = 1 + llen[i]; mi' = 0
+            tr += [(nij, C, 1), (nij, X + off["llen"] + i, 1),
+                   (nij, X + nij, -1), (mij, X + mij, -1)]
+        tr += [(off["ctr"] + C_NLEADERS, C, 1),
+               # the three feat maxes, pre-differenced in the features
+               (off["feat"] + F_BL2_SEEN, FS + F["bl2"] + i, 1),
+               (off["feat"] + F_NJBL, FS + F["njbl"] + i, 1),
+               (off["feat"] + F_LCDCC, FS + F["lcdcc"], 1),
+               (off["ctr"] + C_GLOBLEN, C, 1)]
+        return tr
+
+    def d_client_request(off, lay, i, v):
+        F, FS, C = off["_feat"], off["_src_f"], off["_const"]
+        vb = lay.value_bits
+        cv = (VALUE_ENTRY << vb) | int(v)     # the term-free entry bits
+        tshift = 1 << (1 + vb)                # term field scale
+        tr = []
+        for p in range(lay.Lcap):
+            lp = off["log"] + i * lay.Lcap + p
+            fp = i * lay.Lcap + p
+            # log[i, llen] = pack_entry(ct, VALUE_ENTRY, v): the llen
+            # one-hot places it, × ct scales the term field, × old log
+            # word cancels the overwritten value — overflow zeroes all
+            tr += [(lp, FS + F["croh"] + fp, cv),
+                   (lp, FS + F["crohct"] + fp, tshift),
+                   (lp, FS + F["crohold"] + fp, -1)]
+        tr += [(off["llen"] + i, FS + F["crroom"] + i, 1),
+               (off["ctr"] + C_NREQ, C, 1),
+               (off["ctr"] + C_OVERFLOW, C, 1),
+               (off["ctr"] + C_OVERFLOW, FS + F["crroom"] + i, -1)]
+        return tr
+
+    def d_duplicate(off, lay, k):
+        return [(off["cnt"] + k, off["_const"], 1)]
+
+    def d_drop(off, lay, k):
+        X = off["_src_x"]
+        tr = [(off["cnt"] + k, X + off["cnt"] + k, -1)]
+        for w in range(lay.msg_words):
+            bw = off["bag"] + k * lay.msg_words + w
+            tr.append((bw, X + bw, -1))
+        return tr
+
     fams.append(Family(
         "RequestVote", kern.request_vote, ij,
         lambda i, j: f"RequestVote({i},{j})",
@@ -70,11 +149,13 @@ def build_families(lay) -> List["Family"]:
         "BecomeLeader", kern.become_leader, i_,
         lambda i: f"BecomeLeader({i})",
         guard=lambda off, lay, i: (
-            [(off["cand"] + i, 1), (off["blq"] + i, 1)], 2)))
+            [(off["cand"] + i, 1), (off["blq"] + i, 1)], 2),
+        delta=d_become_leader))
     fams.append(Family(
         "ClientRequest", kern.client_request, iv,
         lambda i, v: f"ClientRequest({i},{v})",
-        guard=lambda off, lay, i, v: ([(off["leader"] + i, 1)], 1)))
+        guard=lambda off, lay, i, v: ([(off["leader"] + i, 1)], 1),
+        delta=d_client_request))
     fams.append(Family(
         "AdvanceCommitIndex", kern.advance_commit_index, i_,
         lambda i: f"AdvanceCommitIndex({i})",
@@ -101,7 +182,8 @@ def build_families(lay) -> List["Family"]:
         "Timeout", kern.timeout, i_,
         lambda i: f"Timeout({i})",
         guard=lambda off, lay, i: (
-            [(off["folc"] + i, 1), (off["cfg"] + i * lay.S + i, 1)], 2)))
+            [(off["folc"] + i, 1), (off["cfg"] + i * lay.S + i, 1)], 2),
+        delta=d_timeout))
     if cfg.next_family in (NEXT_ASYNC_CRASH, NEXT_FULL, NEXT_DYNAMIC):
         fams.append(Family(
             "Restart", lambda sv, der, i: kern.restart(sv, i), i_,
@@ -111,11 +193,13 @@ def build_families(lay) -> List["Family"]:
         fams.append(Family(
             "Duplicate", lambda sv, der, k: kern.duplicate_message(sv, k),
             k_, lambda k: f"Duplicate[slot{k}]",
-            guard=lambda off, lay, k: ([(off["cnt1"] + k, 1)], 1)))
+            guard=lambda off, lay, k: ([(off["cnt1"] + k, 1)], 1),
+            delta=d_duplicate))
         fams.append(Family(
             "Drop", lambda sv, der, k: kern.drop_message(sv, k),
             k_, lambda k: f"Drop[slot{k}]",
-            guard=lambda off, lay, k: ([(off["cnt1"] + k, 1)], 1)))
+            guard=lambda off, lay, k: ([(off["cnt1"] + k, 1)], 1),
+            delta=d_drop))
     if cfg.next_family == NEXT_DYNAMIC:
         fams.append(Family(
             "AddNewServer", kern.add_new_server, ij,

@@ -295,3 +295,59 @@ def test_batched_wave_of_identical_options_slow():
     eng = Engine(MICRO)
     for o, d in zip(rep.outcomes, (2, 3, 4, 5, 6)):
         _same(o.res, eng.check(max_depth=d))
+
+
+# ---------------------------------------------------------------------
+# LRU-by-bytes eviction (round 11, ROADMAP 1: --cache-max-bytes)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_result_cache_lru_eviction_by_bytes(tmp_path):
+    """With max_bytes set, put trims the directory back under the
+    bound, least-recently-USED first; a get refreshes recency, and the
+    just-written payload is never the victim."""
+    pad = "x" * 200                      # ~220 B/payload on disk
+    cache = ResultCache(str(tmp_path), max_bytes=3 * 260)
+    t = 1_000_000_000
+    for i, key in enumerate(("k0", "k1", "k2")):
+        cache.put(key, {"n": i, "pad": pad})
+        t += 10
+        os.utime(os.path.join(str(tmp_path), key + ".json"),
+                 (t, t))                 # deterministic recency order
+    assert len(cache) == 3
+    # touch k0: now k1 is the least recently used
+    fresh = ResultCache(str(tmp_path), max_bytes=3 * 260)
+    assert fresh.get("k0")["n"] == 0
+    t += 10
+    os.utime(os.path.join(str(tmp_path), "k0.json"), (t, t))
+    fresh.put("k3", {"n": 3, "pad": pad})
+    names = sorted(nm for nm in os.listdir(str(tmp_path))
+                   if nm.endswith(".json"))
+    assert "k3.json" in names            # never evicts its own put
+    assert "k0.json" in names            # refreshed by the get
+    assert "k1.json" not in names        # the LRU victim
+    # evicted keys miss even through the in-process dict
+    assert fresh.get("k1") is None
+
+
+@pytest.mark.smoke
+def test_result_cache_unbounded_and_bad_bound(tmp_path):
+    """max_bytes=None preserves the historical unbounded behavior;
+    a non-positive bound errors at construction, not mid-batch."""
+    cache = ResultCache(str(tmp_path / "c"))
+    for i in range(8):
+        cache.put(f"k{i}", {"n": i, "pad": "y" * 500})
+    assert len(cache) == 8
+    with pytest.raises(ValueError, match="max_bytes"):
+        ResultCache(str(tmp_path / "d"), max_bytes=0)
+
+
+def test_result_cache_eviction_serves_survivors(tmp_path):
+    """End-to-end: a bounded cache under run_jobs still serves the
+    surviving key with zero dispatches after eviction pressure."""
+    cache = ResultCache(str(tmp_path), max_bytes=1 << 20)
+    run_jobs([Job(PAX, max_depth=2, label="a")], cache=cache)
+    rep = run_jobs([Job(PAX, max_depth=2, label="b")], cache=cache)
+    assert rep.meta["cache_hits"] == 1
+    assert rep.meta["batch_dispatches"] == 0

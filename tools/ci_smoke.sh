@@ -15,6 +15,10 @@ env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m smoke \
     -p no:cacheprovider "$@"
 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 env JAX_PLATFORMS=cpu python tools/guard_matmul_smoke.py
+# delta-matmul gate (round 11): depth-capped CLI ON ≡ OFF count parity
+# for the scatter-as-matmul successor path, raft AND paxos (the paxos
+# run proves the declarations-only tenant needs zero kernels)
+env JAX_PLATFORMS=cpu python tools/delta_smoke.py
 # spec-agnostic frontend gate (round 10): one depth-capped
 # `check --spec paxos` pinned against the in-process oracle, plus the
 # engine-layer grep gate (engine/ and parallel/ must never import

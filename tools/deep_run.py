@@ -77,7 +77,8 @@ def main():
     depth = int(args.pop(0))
     flags = {f: f in args for f in ("--fp128", "--classic", "--native",
                                     "--host-table", "--no-burst",
-                                    "--no-guard-matmul")}
+                                    "--no-guard-matmul",
+                                    "--no-delta-matmul")}
     for f, on in flags.items():
         if on:
             args.remove(f)
@@ -132,6 +133,7 @@ def main():
         except ValueError as e:
             raise SystemExit(f"--fam-cap-density: {e}") from None
     mxu_kw = dict(guard_matmul=guard_matmul, dedup_kernel=dedup_kernel,
+                  delta_matmul=not flags["--no-delta-matmul"],
                   fam_density=fam_density)
     tag = opts.get("--tag",
                    ("paxos_" if spec == "paxos" else "")
@@ -248,6 +250,7 @@ def main():
         # produced this row
         "guard_matmul": int(r.guard_matmul),
         "dedup_kernel": int(r.dedup_kernel),
+        "delta_matmul": int(r.delta_matmul),
         "resumed_from_checkpoint": bool(resume),
         "expected_fp_collisions": float(
             r.distinct_states ** 2 /

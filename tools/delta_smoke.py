@@ -1,0 +1,75 @@
+"""Delta-matmul CI smoke (tools/ci_smoke.sh step, round 11).
+
+Depth-capped CLI checks with ``--delta-matmul`` (successor generation
+as the group scatter-as-matmul) vs ``--no-delta-matmul`` (the
+per-family kernel path) must land on IDENTICAL counts — for the raft
+small config AND for the stock paxos model, whose four families run
+the delta path with zero hand-written kernels.  Exercises the
+end-to-end flag wiring (CLI → engine → Expander) plus the stats mode
+flags (delta_matmul 1/0).
+
+Sub-minute on CPU; the full-space duplicates live in
+tests/test_delta_matmul.py.  Exits 0 on identity, 1 with a message on
+any divergence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fail(msg):
+    print(f"delta_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_one(spec_args, flag, stats_path):
+    cmd = [sys.executable, "-m", "raft_tla_tpu", "check"] + \
+        spec_args + [flag, "--stats-json", stats_path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, env=env, cwd=_REPO,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        fail(f"check {' '.join(spec_args[:1])} {flag} failed "
+             f"rc={proc.returncode}:\n{proc.stderr}")
+    with open(stats_path) as fh:
+        return json.load(fh)
+
+
+def ab(name, spec_args, td):
+    on = run_one(spec_args, "--delta-matmul",
+                 os.path.join(td, f"{name}_on.json"))
+    off = run_one(spec_args, "--no-delta-matmul",
+                  os.path.join(td, f"{name}_off.json"))
+    if on.get("delta_matmul") != 1 or off.get("delta_matmul") != 0:
+        fail(f"{name}: mode flags wrong: on={on.get('delta_matmul')} "
+             f"off={off.get('delta_matmul')} — the CLI flag did not "
+             "reach the engine")
+    for key in ("distinct_states", "generated_states", "depth",
+                "dedup_hit_rate", "violations"):
+        if on[key] != off[key]:
+            fail(f"{name} {key}: delta-matmul {on[key]} != kernel "
+                 f"path {off[key]} — the delta path diverged")
+    print(f"delta_smoke: {name} ON ≡ OFF at depth {on['depth']} "
+          f"({on['distinct_states']} states)")
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="delta_smoke_") as td:
+        ab("raft", [
+            os.path.join(_REPO, "configs", "tlc_membership",
+                         "raft.cfg"),
+            "--servers", "2", "--init-servers", "2",
+            "--max-log-length", "1", "--max-timeouts", "1",
+            "--max-client-requests", "1", "--max-depth", "6"], td)
+        ab("paxos", ["--spec", "paxos", "--max-depth", "6"], td)
+    print("delta_smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,21 +30,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def warm(tag, cfg, **kw):
-    """A depth-2 check per (burst, guard-matmul) mode: the default
-    (burst=True) pass compiles the fused multi-level executable the
-    tiny levels run on; the burst=False pass compiles the per-level
-    step/finalize pair the engine falls back to the moment a level
-    outgrows the burst ring — BOTH are hit by every real run, so both
-    land in the persistent cache here.  Round 9: each burst mode warms
-    under BOTH matmul modes (the default MXU guard-matmul path and the
-    --no-guard-matmul lane sweep), so an A/B session pays no cold
-    compiles either way."""
+    """A depth-2 check per (burst, guard-matmul, delta-matmul) mode:
+    the default (burst=True) pass compiles the fused multi-level
+    executable the tiny levels run on; the burst=False pass compiles
+    the per-level step/finalize pair the engine falls back to the
+    moment a level outgrows the burst ring — BOTH are hit by every
+    real run, so both land in the persistent cache here.  Round 9:
+    each burst mode warms under BOTH matmul modes (the default MXU
+    guard-matmul path and the --no-guard-matmul lane sweep); round 11
+    adds the delta-matmul successor modes — matmul modes pair with
+    their matching delta mode plus the two cross-mode A/B programs
+    (gm ON × delta OFF and gm OFF × delta ON), so any
+    --[no-]guard-matmul/--[no-]delta-matmul session pays no cold
+    compiles."""
     from raft_tla_tpu.engine.bfs import Engine
     t0 = time.time()
-    for gm in (True, False):
+    for gm, dm in ((True, True), (True, False),
+                   (False, True), (False, False)):
         for burst in (True, False):
             eng = Engine(cfg, store_states=False, burst=burst,
-                         guard_matmul=gm, **kw)
+                         guard_matmul=gm, delta_matmul=dm, **kw)
             eng.check(max_depth=2)
     print(f"{tag}: warmed in {time.time() - t0:.1f}s "
           f"(chunk={eng.chunk} LCAP={eng.LCAP} VCAP={eng.VCAP} "
@@ -64,10 +69,13 @@ def warm_spill(tag, cfg, **kw):
     from raft_tla_tpu.engine.spill import SpillEngine
     t0 = time.time()
     modes = (True, False) if not kw.get("host_table") else (False,)
-    for gm in (True, False):           # both matmul modes (round 9)
+    # both matmul modes (round 9) × both delta modes (round 11; the
+    # cross-mode combinations matter only for the classic engine's
+    # A/B sessions — spill warms the two default-paired programs)
+    for gm, dm in ((True, True), (False, False)):
         for burst in modes:
             eng = SpillEngine(cfg, store_states=False, burst=burst,
-                              guard_matmul=gm, **kw)
+                              guard_matmul=gm, delta_matmul=dm, **kw)
             eng.check(max_depth=2)
     print(f"{tag}: warmed in {time.time() - t0:.1f}s "
           f"(chunk={eng.chunk} SEGL={eng.SEGL} VCAP={eng.VCAP} "
