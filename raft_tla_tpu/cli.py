@@ -302,6 +302,30 @@ def _add_obs_flags(sp):
                          "trace lines up with --trace-timeline")
 
 
+def _install_chaos(args):
+    """--chaos SPEC -> the process-global schedule (resil/chaos);
+    returns an error string on a malformed spec."""
+    if not getattr(args, "chaos", None):
+        return None
+    from .resil.chaos import ChaosSpecError, install
+    try:
+        install(args.chaos)
+    except ChaosSpecError as e:
+        return str(e)
+    return None
+
+
+def _check_retry_flags(args):
+    if getattr(args, "retries", 0) < 0:
+        return f"--retries must be >= 0 (got {args.retries})"
+    if getattr(args, "backoff", 1.0) <= 0:
+        return f"--backoff must be positive (got {args.backoff})"
+    if getattr(args, "ckpt_keep", 1) is not None and \
+            getattr(args, "ckpt_keep", 1) < 1:
+        return f"--ckpt-keep must be >= 1 (got {args.ckpt_keep})"
+    return None
+
+
 def cmd_check(args):
     ir, cfg = _load_cfg(args)
     if args.engine == "oracle" and (args.resume or args.checkpoint):
@@ -311,6 +335,19 @@ def cmd_check(args):
     if args.resume and args.seed_trace:
         print("--resume and --seed-trace are mutually exclusive",
               file=sys.stderr)
+        return 2
+    if args.resume_portable and not args.resume:
+        print("--resume-portable qualifies --resume: pass the "
+              "checkpoint with --resume FILE", file=sys.stderr)
+        return 2
+    if args.resume_portable and not args.spill:
+        print("--resume-portable re-partitions any engine family's "
+              "checkpoint onto the spill engine: add --spill",
+              file=sys.stderr)
+        return 2
+    err = _check_retry_flags(args) or _install_chaos(args)
+    if err:
+        print(err, file=sys.stderr)
         return 2
     oracle_seeds = engine_seeds = None
     if args.seed_trace:
@@ -378,38 +415,57 @@ def cmd_check(args):
                         dedup_kernel=args.dedup_kernel,
                         delta_matmul=args.delta_matmul,
                         fam_density=fam_density)
-        if args.spill:
-            # host-spill engine: levels stream through host RAM, for
-            # depths whose level buffers exceed HBM (engine/spill);
-            # --host-table additionally moves the visited set to
-            # fingerprint-prefix partitions in host RAM, streamed
-            # through HBM per level (engine/host_table) — the ceiling
-            # becomes host RAM, not the chip
-            from .engine.spill import SpillEngine
-            eng = SpillEngine(cfg, chunk=args.chunk,
-                              store_states=not args.no_store,
-                              seg=args.seg,
-                              host_table=args.host_table,
-                              partitions=args.partitions,
-                              part_cap=args.part_cap,
-                              archive_dir=args.archive_dir,
-                              **burst_kw)
-        else:
-            eng = Engine(cfg, chunk=args.chunk,
-                         store_states=not args.no_store,
-                         archive_dir=args.archive_dir,
-                         **burst_kw)
+
+        def make_engine():
+            # one fresh engine per supervised attempt — the backend-
+            # reinit contract (resil/supervisor): a retry re-traces
+            # against a reconnected backend instead of reusing
+            # executables that may hold dead tunnel handles
+            if args.spill:
+                # host-spill engine: levels stream through host RAM,
+                # for depths whose level buffers exceed HBM
+                # (engine/spill); --host-table additionally moves the
+                # visited set to fingerprint-prefix partitions in host
+                # RAM, streamed through HBM per level
+                # (engine/host_table) — the ceiling becomes host RAM,
+                # not the chip
+                from .engine.spill import SpillEngine
+                eng = SpillEngine(cfg, chunk=args.chunk,
+                                  store_states=not args.no_store,
+                                  seg=args.seg,
+                                  host_table=args.host_table,
+                                  partitions=args.partitions,
+                                  part_cap=args.part_cap,
+                                  archive_dir=args.archive_dir,
+                                  **burst_kw)
+            else:
+                eng = Engine(cfg, chunk=args.chunk,
+                             store_states=not args.no_store,
+                             archive_dir=args.archive_dir,
+                             **burst_kw)
+            eng.ckpt_keep = args.ckpt_keep
+            return eng
+        from .resil.supervisor import RetryExhausted, supervised_check
         obs = _build_obs(args, ir)
         obs.start()
         done = False
         try:
-            r = eng.check(max_depth=args.max_depth,
-                          max_states=args.max_states,
-                          stop_on_violation=not args.keep_going,
-                          verbose=args.verbose, seed_states=engine_seeds,
-                          checkpoint_path=args.checkpoint,
-                          checkpoint_every=args.checkpoint_every,
-                          resume_from=args.resume, obs=obs)
+            resume_image = None
+            if args.resume_portable:
+                from .resil.portable import load_portable_image
+                resume_image = load_portable_image(args.resume)
+            r, eng, _attempts = supervised_check(
+                make_engine, retries=args.retries,
+                backoff=args.backoff, obs=obs,
+                checkpoint_path=args.checkpoint,
+                resume_from=(None if args.resume_portable
+                             else args.resume),
+                resume_image=resume_image,
+                max_depth=args.max_depth,
+                max_states=args.max_states,
+                stop_on_violation=not args.keep_going,
+                verbose=args.verbose, seed_states=engine_seeds,
+                checkpoint_every=args.checkpoint_every)
             done = True
         except (CheckpointError, FileNotFoundError) as e:
             # only checkpoint load/format problems — a mid-run error
@@ -419,6 +475,9 @@ def cmd_check(args):
             print(f"cannot resume from {args.resume}: {e}",
                   file=sys.stderr)
             return 2
+        except RetryExhausted as e:
+            print(str(e), file=sys.stderr)
+            return 3
         finally:
             # the final heartbeat carries the run's reported depth (so
             # a watchdog sees "finished" with depth == the stats line)
@@ -712,17 +771,47 @@ def cmd_batch(args):
         print("--cache-max-bytes bounds the on-disk result cache: "
               "add --cache-dir", file=sys.stderr)
         return 2
+    if args.wave_yield is not None and args.wave_yield < 1:
+        print(f"--wave-yield must be >= 1 (got {args.wave_yield})",
+              file=sys.stderr)
+        return 2
+    err = _check_retry_flags(args) or _install_chaos(args)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
     cache = ResultCache(args.cache_dir,
                         max_bytes=args.cache_max_bytes) \
         if args.cache_dir else None
     obs = _build_obs(args)
     obs.start()
     done = False
+    rep = None
+    import time as _time
+    from .resil.supervisor import RETRYABLE, backoff_delay
+    attempt = 0
     try:
-        rep = run_jobs(jobs, cache=cache, obs=obs,
-                       sequential=args.sequential,
-                       verbose=args.verbose)
-        done = True
+        while True:
+            try:
+                rep = run_jobs(jobs, cache=cache, obs=obs,
+                               sequential=args.sequential,
+                               verbose=args.verbose,
+                               wave_state=args.wave_state,
+                               wave_yield=args.wave_yield)
+                done = True
+                break
+            except RETRYABLE as e:
+                # a retried batch is incremental: finished jobs answer
+                # from the result cache, stragglers resume mid-BFS
+                # from --wave-state
+                if attempt >= args.retries:
+                    print(f"batch run failed: {e}", file=sys.stderr)
+                    return 3
+                wait = backoff_delay(attempt, args.backoff, 60.0)
+                obs.retry(attempt=attempt + 1,
+                          max_attempts=args.retries + 1,
+                          wait_s=wait, error=e)
+                _time.sleep(wait)
+                attempt += 1
     finally:
         if done:
             obs.finish(
@@ -901,7 +990,43 @@ def main(argv=None):
                          "deep store_states runs are I/O-heavy)")
     pc.add_argument("--resume", default=None, metavar="FILE",
                     help="resume a checkpointed run (final counts are "
-                         "identical to an uninterrupted run)")
+                         "identical to an uninterrupted run).  A torn "
+                         "or corrupt head falls back to the previous "
+                         "valid checkpoint in the last-K chain with a "
+                         "named warning")
+    pc.add_argument("--ckpt-keep", type=int, default=2, metavar="K",
+                    help="checkpoint-chain depth: keep the last K "
+                         "checkpoints (FILE, FILE.1, ...), each with "
+                         "a sha256 integrity sidecar, so a crash "
+                         "mid-write never strands the run (default 2; "
+                         "1 = the historical single file)")
+    pc.add_argument("--resume-portable", action="store_true",
+                    help="shape-portable resume (needs --spill): "
+                         "re-partition ANY engine family's checkpoint "
+                         "— classic, spill, or a mesh of any device "
+                         "count — onto this engine by re-inserting "
+                         "the visited key set and re-routing the "
+                         "frontier (resil/portable)")
+    pc.add_argument("--retries", type=int, default=0, metavar="N",
+                    help="supervised retry/backoff (resil/supervisor): "
+                         "on a transient failure (dropped tunnel, "
+                         "device error), reinit the backend and "
+                         "resume from the newest valid checkpoint, up "
+                         "to N times with bounded exponential backoff "
+                         "+ jitter; attempts are stamped into the "
+                         "ledger and heartbeat")
+    pc.add_argument("--backoff", type=float, default=2.0, metavar="S",
+                    help="base backoff seconds for --retries "
+                         "(doubles per attempt, capped at 60s, "
+                         "deterministic jitter)")
+    pc.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="deterministic fault injection "
+                         "(resil/chaos): e.g. "
+                         "'dispatch:every=2;ckpt_torn:at=1' — seeded "
+                         "schedule firing at named engine sites "
+                         "(dispatch, ckpt_torn, ckpt_corrupt, "
+                         "archive, host_table, wave_kill), so every "
+                         "recovery path is testable on CPU")
     pc.add_argument("--seed-trace", default=None, metavar="FILE",
                     help="punctuated search: explore only extensions of "
                          "the seed state(s) in FILE (emitted by `trace "
@@ -1012,6 +1137,30 @@ def main(argv=None):
                     help="run each job on its own engine instead of "
                          "the batched path (the honest A/B reference "
                          "— N jobs pay N compiles)")
+    pb.add_argument("--wave-state", default=None, metavar="DIR",
+                    help="preemptible waves (serve/wavestate): "
+                         "persist every live job's carry slice at "
+                         "each wave boundary, so a killed run "
+                         "resumes finished jobs from --cache-dir and "
+                         "stragglers mid-BFS — bit-exact per job")
+    pb.add_argument("--wave-yield", type=int, default=None,
+                    metavar="N",
+                    help="preemption: a wave yields its lanes after "
+                         "N batched device calls while other jobs "
+                         "wait (higher Job priority runs first); "
+                         "parked jobs continue in a later wave")
+    pb.add_argument("--retries", type=int, default=0, metavar="N",
+                    help="re-run the job list up to N times on a "
+                         "transient failure, with bounded exponential "
+                         "backoff — incremental via --cache-dir + "
+                         "--wave-state")
+    pb.add_argument("--backoff", type=float, default=2.0, metavar="S",
+                    help="base backoff seconds for --retries")
+    pb.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="deterministic fault injection (resil/"
+                         "chaos); 'wave_kill:at=1' is the "
+                         "deterministic SIGKILL stand-in the CI "
+                         "chaos smoke uses")
     pb.add_argument("--stats-json", default=None, metavar="FILE",
                     help="write the batch summary + per-job reports "
                          "as one JSON file")

@@ -106,6 +106,11 @@ class DiskArchive:
         part is ``dict(n=…, lpar=…, llane=…)`` plus either
         ``rows`` (batch-LAST state arrays, the spill block layout) or
         ``rows_major`` (batch-major)."""
+        # chaos site: a disk I/O failure before this level's memmaps
+        # are written.  meta.json still names only complete levels, so
+        # a resume reattaches + truncates and re-appends bit-exact.
+        from ..resil.chaos import chaos_point
+        chaos_point("archive")
         i = len(self.level_rows)
         n = sum(int(p["n"]) for p in parts)
         first = parts[0]

@@ -57,6 +57,7 @@ from ..obs.metrics import CHECK_COUNTER_KEYS
 from ..ops.codec import C_OVERFLOW
 from ..spec import spec_of
 from ..utils import HOME_SALT
+from ..resil.chaos import chaos_point
 from ..utils import cat_arrays as _cat
 from ..utils import fmix32_int as _fmix32_int
 from ..utils import fp_key
@@ -251,7 +252,12 @@ _CKPT_BASE_KEYS = ("cfg", "chunk", "store_states", "n_levels",
 
 
 def ckpt_write(path, carry, store_states, parents, lanes, states, res,
-               meta):
+               meta, keep: int = 1):
+    """``keep`` > 1 keeps a last-K chain (path, path.1, ..) with the
+    previous heads rotated down before the atomic publish; every
+    member carries a sha256 sidecar (resil/ckpt_chain) so a torn or
+    corrupt head is detected BEFORE any array is read and resume
+    falls back to the newest valid predecessor."""
     import json
     import os
     data = {}
@@ -281,7 +287,10 @@ def ckpt_write(path, carry, store_states, parents, lanes, states, res,
     data["meta"] = np.array(json.dumps({**base, **meta}))
     tmp = path + ".tmp.npz"           # .npz suffix: savez won't append
     np.savez(tmp, **data)
-    os.replace(tmp, path)
+    # rotate + publish + checksum sidecar (+ the ckpt_torn/ckpt_corrupt
+    # chaos sites, applied to the fresh head only)
+    from ..resil.ckpt_chain import publish
+    publish(tmp, path, keep=keep)
 
 
 def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded, spill=False,
@@ -297,16 +306,23 @@ def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded, spill=False,
     spec_name — the resuming engine's SpecIR name: resume refuses on a
     spec mismatch (same pattern as the config-mismatch refusal below;
     meta lacking the key reads as "raft" — every pre-IR checkpoint is
-    a Raft one)."""
+    a Raft one).
+
+    Integrity (round 12, resil/ckpt_chain): the file's sha256 sidecar
+    is verified BEFORE any array is touched — a truncated or corrupt
+    file is a clear named condition, never a numpy/zipfile traceback —
+    and a bad head falls back (with a ChainWarning) to the newest
+    valid predecessor in the last-K chain ``path, path.1, ...``."""
     import json
+    from ..resil.ckpt_chain import (IntegrityError, load_engine_npz,
+                                    open_validated)
+    # payload-integrity validation before ANY meta compare: the digest
+    # check runs first; the structural loader catches legacy
+    # no-sidecar files whose zip container or meta record is torn
     try:
-        z = np.load(path, allow_pickle=False)
-    except (ValueError, OSError) as e:
-        raise CheckpointError(
-            f"{path}: not a readable checkpoint ({e})") from e
-    if "meta" not in z:
-        raise CheckpointError(f"{path}: not an engine checkpoint "
-                              "(no meta record)")
+        z, path = open_validated(path, load_engine_npz)
+    except IntegrityError as e:
+        raise CheckpointError(str(e)) from e
     meta = json.loads(str(z["meta"]))
     if spec_name is not None:
         got_spec = meta.get("spec", "raft")
@@ -565,6 +581,12 @@ class Engine:
         # job-axis batched burst (serve/batch) — built lazily by
         # burst_batched_fn, so solo checks never trace it
         self._bat_jit = None
+        # checkpoint-chain depth (resil/ckpt_chain): keep the last K
+        # checkpoints (path, path.1, ...) so a torn head falls back to
+        # a valid predecessor; 1 restores the historical single file.
+        # An attribute (not a ctor kwarg) so all four engine families
+        # inherit it and the CLI sets it in one place (--ckpt-keep).
+        self.ckpt_keep = 2
 
     def _round_cap(self, n: int) -> int:
         c = self.chunk
@@ -1613,6 +1635,42 @@ class Engine:
         self._parents, self._lanes, self._states = ckpt_archives(
             z, meta, template, self.store_states)
 
+    def _restore_portable_archives(self, img):
+        """Shape-portable twin of _load_archives: attach the archives a
+        ``resil.portable.PortableImage`` carries (the in-RAM per-level
+        lists, or a disk-archive reattach+truncate).  The archive
+        format is engine-agnostic — parents/lanes/state rows in global
+        id order — so archives port across engine families unchanged."""
+        from .archive import ArchiveError, DiskArchive
+        self._arch = None
+        self._parents, self._lanes, self._states = [], [], []
+        if not self.store_states:
+            return
+        if not img.store_states:
+            raise CheckpointError(
+                "portable image was written with store_states=False; "
+                "resume with store_states=False (CLI: --no-store) — "
+                "trace archives cannot be reconstructed")
+        if img.disk_archive_levels is not None:
+            if not self.archive_dir:
+                raise CheckpointError(
+                    f"{img.source_path}: image archives live in a "
+                    "disk archive directory — resume with the same "
+                    "archive_dir (CLI: --archive-dir)")
+            try:
+                self._arch = DiskArchive(self.archive_dir, attach=True)
+                self._arch.truncate(img.disk_archive_levels)
+            except ArchiveError as e:
+                raise CheckpointError(str(e)) from e
+            return
+        if self.archive_dir:
+            raise CheckpointError(
+                f"{img.source_path}: image holds in-RAM archives; "
+                "resume without archive_dir")
+        self._parents = list(img.parents)
+        self._lanes = list(img.lanes)
+        self._states = [dict(s) for s in img.states]
+
     def check(self, max_depth: int = 10 ** 9, max_states: int = 10 ** 9,
               stop_on_violation: bool = False,
               seed_states: Optional[List] = None,
@@ -1791,6 +1849,11 @@ class Engine:
         burst_ok = True
         while n_front and depth < max_depth and \
                 res.distinct_states < max_states:
+            # chaos site: a dispatch-time device/tunnel error at the
+            # level boundary (resil/chaos).  Raised BEFORE any device
+            # work, so the last checkpoint/archives stay consistent
+            # and the supervised runner resumes bit-exact.
+            chaos_point("dispatch")
             if self.burst and burst_ok and \
                     n_front <= self._burst_width():
                 # small-level burst: run up to burst_levels levels in
@@ -2059,7 +2122,8 @@ class Engine:
                            layout=2, chunk=self.chunk,
                            spec=self.ir.name,
                            ir_fingerprint=self.ir.fingerprint(),
-                           cfg=repr(self.cfg)))
+                           cfg=repr(self.cfg)),
+                       keep=self.ckpt_keep)
 
     def _load_checkpoint(self, path):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,

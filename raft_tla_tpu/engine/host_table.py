@@ -242,6 +242,12 @@ class HostPartitionedTable:
         the kept keys.  ``keys`` must be unique (the engines' device
         cache guarantees level-local uniqueness) and in enumeration
         order."""
+        # chaos site: host-partition loss (the partitions live with the
+        # host process — a killed host loses them; recovery rebuilds
+        # them from the checkpoint's sparse images or, shape-portably,
+        # by re-sweeping the visited key set)
+        from ..resil.chaos import chaos_point
+        chaos_point("host_table")
         seen = self.member(keys)
         self.commit(keys, ~seen)
         return ~seen

@@ -68,7 +68,8 @@ from .bfs import (CheckResult, CheckpointError, Engine, U32MAX,
                   _HOME_SALT, Violation, ckpt_read, ckpt_result,
                   ckpt_write)
 from .fingerprint import fmix32
-from .host_table import HostPartitionedTable
+from .host_table import HostPartitionedTable, insert_np
+from ..resil.chaos import chaos_point
 
 # summary vector layout (int32): the per-window device->host sync
 (S_NLVL, S_NGEN, S_OVF, S_FOVF, S_HOVF, S_OOVF, S_TRIP, S_OFX,
@@ -571,6 +572,10 @@ class SpillEngine(Engine):
         host link while the device probes (the spill engine's
         double-buffering discipline) — then commit the fresh keys into
         the host partitions.  Returns keep = not-seen-before [N]."""
+        # chaos site: host-partition loss (this device-streamed sweep
+        # is the single-chip twin of HostPartitionedTable.sweep, which
+        # carries the same site for the mesh composition)
+        chaos_point("host_table")
         with self._obs.span("host_sweep"):
             return self._sweep_level_keys_impl(keys)
 
@@ -813,11 +818,21 @@ class SpillEngine(Engine):
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
+              resume_image=None,
               verbose: bool = False, obs=None) -> CheckResult:
+        """``resume_image`` — a ``resil.portable.PortableImage`` from
+        ANY engine family's checkpoint: the visited key set rebuilds
+        this engine's table image (and host partitions) and the
+        frontier rows become one spill block, so a mesh or classic
+        checkpoint resumes here after a shape change (ROADMAP item-2
+        elastic resume)."""
         obs = self._obs = obs if obs is not None else NULL_OBS
         t0 = time.perf_counter()
         lay = self.lay
         frontier_keys: List[np.ndarray] = []   # host-table mode only
+        if resume_from is not None and resume_image is not None:
+            raise ValueError(
+                "resume_from and resume_image are mutually exclusive")
 
         def prewarm():
             # the segment driver's streamed step warms at run start so
@@ -833,6 +848,11 @@ class SpillEngine(Engine):
             (carry, res, frontier_blocks, frontier_keys, n_states,
              n_vis, depth) = self._load_spill_checkpoint(resume_from)
             prewarm()        # beside the loaded carry (resume-only)
+            root_blk = None
+        elif resume_image is not None:
+            (carry, res, frontier_blocks, frontier_keys, n_states,
+             n_vis, depth) = self._resume_portable(resume_image)
+            prewarm()
             root_blk = None
         else:
             self._init_store()
@@ -1004,6 +1024,10 @@ class SpillEngine(Engine):
         burst_ok = True
         while frontier_blocks and depth < max_depth and \
                 res.distinct_states < max_states:
+            # chaos site: dispatch-time device/tunnel error at the
+            # level boundary (resil/chaos) — before any device work,
+            # so the last checkpoint stays the exact resume point
+            chaos_point("dispatch")
             if (self.burst and burst_ok and not self.host_table and
                     sum(int(g.shape[0]) for _r, g in frontier_blocks)
                     <= self._burst_width()):
@@ -1309,7 +1333,69 @@ class SpillEngine(Engine):
                        layout=2, chunk=self.chunk,
                        spec=self.ir.name,
                        ir_fingerprint=self.ir.fingerprint(),
-                       cfg=repr(self.cfg)))
+                       cfg=repr(self.cfg)),
+                   keep=self.ckpt_keep)
+
+    def _resume_portable(self, img):
+        """Rebuild this engine's level-boundary state from a
+        ``resil.portable.PortableImage`` (any source engine family /
+        shape): the visited key set re-inserts into a fresh table
+        image via the host claim-insert twin (engine/host_table
+        ``insert_np`` — same home hash and probe walk as the device),
+        the frontier rows become one spill block, and under
+        ``host_table`` the host partitions rebuild by re-sweeping the
+        whole key set (a re-partition: ANY --partitions works)."""
+        from ..resil.portable import validate_image
+        validate_image(img, self.ir.name, repr(self.cfg), self.W)
+        self._restore_portable_archives(img)
+        keys = img.keys.astype(np.uint32)
+        rows, gids = img.expandable()
+        frontier_blocks = []
+        if gids.shape[0]:
+            frontier_blocks.append((
+                {k: np.ascontiguousarray(np.moveaxis(v, 0, -1))
+                 for k, v in rows.items()}, gids))
+        frontier_keys: List[np.ndarray] = []
+        if self.host_table:
+            # the authoritative set re-partitions into fresh host
+            # images (chunked sweeps — every key is fresh by
+            # construction); the device table reseeds with just the
+            # frontier's keys, exactly the reseed-at-boundary state
+            self.hpt = HostPartitionedTable(
+                self.W, partitions=self.partitions,
+                part_cap=self.part_cap)
+            step = 1 << 16
+            for i in range(0, keys.shape[0], step):
+                self.hpt.sweep(np.ascontiguousarray(keys[i:i + step]))
+            if gids.shape[0]:
+                b = {k: jnp.asarray(v)
+                     for k, v in self.ir.widen(rows).items()}
+                fkeys = np.asarray(self._rootfp_jit(b)).astype(
+                    np.uint32)
+                frontier_keys.append(fkeys)
+            else:
+                fkeys = np.zeros((0, self.W), np.uint32)
+            self.VCAP = self.VCAP0
+            while fkeys.shape[0] + self.SEGL > \
+                    self._LOAD_MAX * self.VCAP:
+                self.VCAP *= 4
+            tbl = np.full((self.W, self.VCAP), np.uint32(0xFFFFFFFF),
+                          np.uint32)
+            insert_np(tbl, fkeys)
+            n_vis = int(fkeys.shape[0])
+        else:
+            while keys.shape[0] + self.SEGL > \
+                    self._LOAD_MAX * self.VCAP:
+                self.VCAP *= 4
+            tbl = np.full((self.W, self.VCAP), np.uint32(0xFFFFFFFF),
+                          np.uint32)
+            insert_np(tbl, keys)
+            n_vis = int(keys.shape[0])
+        carry = self._fresh_spill_carry()
+        carry["vis"] = tuple(jnp.asarray(tbl[w])
+                             for w in range(self.W))
+        return (carry, img.fresh_result(), frontier_blocks, frontier_keys,
+                img.n_states, n_vis, img.depth)
 
     def _load_spill_checkpoint(self, path):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,

@@ -145,6 +145,28 @@ class Obs:
         jobs here — they finish outside any batched dispatch)."""
         self._last_jobs = dict(jobs)
 
+    def retry(self, *, attempt: int, max_attempts: int, wait_s: float,
+              error):
+        """One supervised-retry event (resil/supervisor): a
+        ``kind="retry"`` ledger record plus a ``status="backoff"``
+        heartbeat rewrite carrying the attempt counters, so a watchdog
+        (tools/watch.py) shows a RETRYING run instead of a silent gap
+        between dispatches."""
+        retry_info = {"attempt": int(attempt),
+                      "max_attempts": int(max_attempts),
+                      "wait_s": round(float(wait_s), 3),
+                      "error": str(error)[:300]}
+        if self.ledger is not None:
+            rec = dict(self.meta)
+            rec["kind"] = "retry"
+            rec.update(retry_info)
+            self.ledger.record(rec)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(depth=self.heartbeat.last_depth,
+                                states=self.heartbeat.last_states,
+                                status="backoff",
+                                extra={"retry": retry_info})
+
     # -- lifecycle (the CLI owns it) ----------------------------------
 
     def start(self):

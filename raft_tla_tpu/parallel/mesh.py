@@ -87,7 +87,9 @@ from ..obs import NULL_OBS
 from ..engine.bfs import (CheckResult, Engine, U32MAX, Violation, _cat,
                           _take, ckpt_archives, ckpt_carry, ckpt_read,
                           ckpt_result, ckpt_write)
+from ..engine.host_table import insert_np
 from ..ops.codec import C_OVERFLOW
+from ..resil.chaos import chaos_point
 
 # sharded checkpoint format gate (shared with MultiHostEngine):
 # format 2 added the content-canonical lrow table (round 4); format 3
@@ -816,17 +818,31 @@ class ShardedEngine(Engine):
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
+              resume_image=None,
               verbose: bool = False, obs=None) -> CheckResult:
+        """``resume_image`` — a ``resil.portable.PortableImage``
+        extracted from ANY engine family's checkpoint: the visited key
+        set and frontier rows are re-partitioned onto THIS mesh by
+        hash ownership, so a checkpoint written on a different device
+        count (or by the spill/classic engines) resumes here
+        (ROADMAP item-2 elastic resume)."""
         obs = self._obs = obs if obs is not None else NULL_OBS
         t0 = time.perf_counter()
         lay = self.lay
         D, W = self.D, self.W
+        if resume_from is not None and resume_image is not None:
+            raise ValueError(
+                "resume_from and resume_image are mutually exclusive")
         if resume_from is not None:
             carry, res, meta = self._load_checkpoint(resume_from)
             n_states = meta["n_states"]
             n_vis = np.asarray(meta["n_vis"], dtype=np.int64)
             depth = meta["depth"]
             n_front = meta["n_front"]
+            resumed = True
+        elif resume_image is not None:
+            (carry, res, n_states, n_vis, depth,
+             n_front) = self._resume_portable(resume_image)
             resumed = True
         else:
             # shared root admission (engine/bfs._dedup_roots), then
@@ -996,6 +1012,10 @@ class ShardedEngine(Engine):
         burst_ok = True
         while n_front and depth < max_depth and \
                 res.distinct_states < max_states:
+            # chaos site: dispatch-time device/tunnel error at the
+            # level boundary (resil/chaos) — before any device work,
+            # so the last checkpoint stays the exact resume point
+            chaos_point("dispatch")
             kbd = self._mesh_burst_width()
             if self.burst and burst_ok and n_front <= kbd:
                 # fused K-level burst: ONE shard_map dispatch + ONE
@@ -1251,7 +1271,65 @@ class ShardedEngine(Engine):
                            n_front=int(n_front),
                            spec=self.ir.name,
                            ir_fingerprint=self.ir.fingerprint(),
-                           cfg=repr(self.cfg)))
+                           cfg=repr(self.cfg)),
+                       keep=self.ckpt_keep)
+
+    def _resume_portable(self, img):
+        """Rebuild a level-boundary carry from a PortableImage: route
+        visited keys and frontier rows to their owner devices
+        (``key[W-1] % D`` — pure content, so any source shape / device
+        count re-partitions here), build per-device table images with
+        the host insert twin, and seed the gids table from the image.
+        Constraint-pruned rows are dropped (they are never expanded;
+        gids are explicit here, so no placeholder rows are needed)."""
+        from ..resil.portable import validate_image
+        D, W = self.D, self.W
+        validate_image(img, self.ir.name, repr(self.cfg), W)
+        self._restore_portable_archives(img)
+        self._arch_segs = [[(0, len(p))] for p in self._parents]
+        keys = img.keys
+        owner = (keys[:, W - 1].astype(np.int64)) % D
+        n_vis = np.bincount(owner, minlength=D).astype(np.int64)
+        rows, gids = img.expandable()
+        if gids.shape[0]:
+            b = {k: jnp.asarray(v)
+                 for k, v in self.ir.widen(rows).items()}
+            fkeys = np.asarray(self._rootfp_jit(b)).astype(np.uint32)
+            fowner = (fkeys[:, W - 1].astype(np.int64)) % D
+        else:
+            fowner = np.zeros((0,), np.int64)
+        per_dev = [np.nonzero(fowner == d)[0] for d in range(D)]
+        max_rows = max((len(p) for p in per_dev), default=0)
+        # grow LB FIRST, then size the table against the final LB —
+        # the same order as root admission: the load bound reserves
+        # headroom for a whole level (up to LB keys), so sizing VB
+        # against a stale smaller LB could leave the shard past its
+        # probe budget right after resume
+        while self.LB - self.D * self.SC < 2 * max(max_rows, 1):
+            self.LB = self._round_lb(2 * self.LB)
+        while int(n_vis.max()) + self.LB > self._LOAD_MAX * self.VB:
+            self.VB *= 4
+        carry_np = self._fresh_sharded_carry_host()
+        for d in range(D):
+            kd = keys[owner == d]
+            if kd.shape[0]:
+                tbl = np.full((W, self.VB), np.uint32(0xFFFFFFFF),
+                              np.uint32)
+                insert_np(tbl, kd.astype(np.uint32))
+                for w in range(W):
+                    carry_np["vis"][w][d] = tbl[w]
+            idx = per_dev[d]
+            n = len(idx)
+            if n:
+                for k in rows:
+                    carry_np["front"][k][d, :n] = rows[k][idx]
+                carry_np["gids"][d, :n] = gids[idx]
+                carry_np["fmask"][d, :n] = True
+            carry_np["n_front"][d] = n
+        carry_np["g_off"][:] = np.int32(img.n_states)
+        carry = self._to_device(carry_np)
+        return (carry, img.fresh_result(), img.n_states, n_vis, img.depth,
+                max_rows)
 
     def _load_checkpoint(self, path):
         from ..engine.bfs import CheckpointError

@@ -49,11 +49,14 @@ import numpy as np
 from jax import lax
 
 from ..config import ModelConfig
-from ..engine.bfs import CheckResult, U32MAX, Violation
+from ..engine.bfs import (CheckResult, CheckpointError, U32MAX,
+                          Violation, ckpt_read, ckpt_result,
+                          ckpt_write)
 from ..obs import NULL_OBS
 from ..engine.host_table import HostPartitionedTable, insert_np
 from ..engine.spill import SpillEngine
 from ..ops.codec import C_OVERFLOW
+from ..resil.chaos import chaos_point
 from .mesh import P, ShardedEngine, _shard_map
 
 # summary row layout ([D, Z_LEN + n_fams] int32, replicated)
@@ -291,12 +294,17 @@ class SpilledShardedEngine(ShardedEngine):
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
+              resume_image=None,
               verbose: bool = False, obs=None) -> CheckResult:
-        if checkpoint_path is not None or resume_from is not None:
-            raise NotImplementedError(
-                "SpilledShardedEngine does not checkpoint yet — use "
-                "ShardedEngine (device-resident) or SpillEngine "
-                "(single-device) for checkpointed runs")
+        """Checkpointing (round 12): at a level boundary the whole
+        wavefront is host-reachable here too — the frontier blocks are
+        host numpy, the visited set is either the device shards (one
+        pooled sparse fetch) or the per-device host partitions, and
+        ownership is a pure function of key content.  The checkpoint
+        therefore stores the wavefront POOLED in gid order (the
+        portable form), and resume re-routes rows and keys by hash
+        ownership — which also makes ``resume_image`` (a checkpoint
+        from any engine family / any mesh size) the same code path."""
         assert jax.process_count() == 1, \
             "single-controller engine (MultiHostEngine composition " \
             "is future work)"
@@ -304,54 +312,67 @@ class SpilledShardedEngine(ShardedEngine):
         t0 = time.perf_counter()
         lay = self.lay
         D, W = self.D, self.W
-        self._init_store()
-        self._cur_parts = []
+        if resume_from is not None and resume_image is not None:
+            raise ValueError(
+                "resume_from and resume_image are mutually exclusive")
+        resumed = False
+        if resume_from is not None:
+            (carry, res, frontier, frontier_keys, n_states, n_vis,
+             depth) = self._load_spill_mesh_checkpoint(resume_from)
+            resumed = True
+        elif resume_image is not None:
+            (carry, res, frontier, frontier_keys, n_states, n_vis,
+             depth) = self._resume_portable(resume_image)
+            resumed = True
+        else:
+            self._init_store()
+            self._cur_parts = []
 
-        # ---- roots: hash-owner placement into host blocks -----------
-        roots, rk, pin_interiors = self._dedup_roots(seed_states)
-        res = CheckResult(distinct_states=0, generated_states=len(rk),
-                          depth=0)
-        self._stamp_mode(res)
-        self._check_pin_interiors(pin_interiors, res)
-        per_dev: List[List[int]] = [[] for _ in range(D)]
-        for r in range(len(rk)):
-            per_dev[int(rk[r, W - 1]) % D].append(r)
-        inv_r, con_r = (np.asarray(a) for a in self._phase2(
-            {k: jnp.asarray(v) for k, v in roots.items()}))
-        roots_n = self.ir.narrow(lay, roots)
+            # ---- roots: hash-owner placement into host blocks -------
+            roots, rk, pin_interiors = self._dedup_roots(seed_states)
+            res = CheckResult(distinct_states=0,
+                              generated_states=len(rk), depth=0)
+            self._check_pin_interiors(pin_interiors, res)
+            per_dev: List[List[int]] = [[] for _ in range(D)]
+            for r in range(len(rk)):
+                per_dev[int(rk[r, W - 1]) % D].append(r)
+            inv_r, con_r = (np.asarray(a) for a in self._phase2(
+                {k: jnp.asarray(v) for k, v in roots.items()}))
+            roots_n = self.ir.narrow(lay, roots)
 
-        if self.host_table:
-            self.hpts = [HostPartitionedTable(
-                W, partitions=self.partitions, part_cap=self.part_cap)
-                for _ in range(D)]
-        carry = self._fresh_sharded_carry()
-        vis_np = [np.array(t) for t in carry["vis"]]   # writable copies
-        root_blks = [None] * D
-        for d in range(D):
-            idx = per_dev[d]
-            if not idx:
-                continue
-            rkd = rk[idx]
-            slots = self._host_probe_assign(rkd, vcap=self.VB)
-            for r, sl in enumerate(slots):
-                for w in range(W):
-                    vis_np[w][d, sl] = rkd[r, w]
-            root_blks[d] = dict(
-                rows={k: np.stack([np.asarray(roots_n[k][i])
-                                   for i in idx]) for k in roots_n},
-                lpar=np.full((len(idx),), -1, np.int32),
-                llane=np.full((len(idx),), -1, np.int32),
-                linv=inv_r[idx], lcon=con_r[idx], n=len(idx))
             if self.host_table:
-                root_blks[d]["lkey"] = rkd.astype(np.uint32)
-                # roots enter the per-device host partitions through
-                # the same sweep as every level (all fresh)
-                self.hpts[d].sweep(root_blks[d]["lkey"])
-        carry["vis"] = tuple(jnp.asarray(v) for v in vis_np)
+                self.hpts = [HostPartitionedTable(
+                    W, partitions=self.partitions,
+                    part_cap=self.part_cap) for _ in range(D)]
+            carry = self._fresh_sharded_carry()
+            vis_np = [np.array(t) for t in carry["vis"]]  # writable
+            root_blks = [None] * D
+            for d in range(D):
+                idx = per_dev[d]
+                if not idx:
+                    continue
+                rkd = rk[idx]
+                slots = self._host_probe_assign(rkd, vcap=self.VB)
+                for r, sl in enumerate(slots):
+                    for w in range(W):
+                        vis_np[w][d, sl] = rkd[r, w]
+                root_blks[d] = dict(
+                    rows={k: np.stack([np.asarray(roots_n[k][i])
+                                       for i in idx]) for k in roots_n},
+                    lpar=np.full((len(idx),), -1, np.int32),
+                    llane=np.full((len(idx),), -1, np.int32),
+                    linv=inv_r[idx], lcon=con_r[idx], n=len(idx))
+                if self.host_table:
+                    root_blks[d]["lkey"] = rkd.astype(np.uint32)
+                    # roots enter the per-device host partitions
+                    # through the same sweep as every level (all fresh)
+                    self.hpts[d].sweep(root_blks[d]["lkey"])
+            carry["vis"] = tuple(jnp.asarray(v) for v in vis_np)
 
-        n_states = 0
-        n_vis = np.array([len(p) for p in per_dev], np.int64)
-        depth = 0
+            n_states = 0
+            n_vis = np.array([len(p) for p in per_dev], np.int64)
+            depth = 0
+        self._stamp_mode(res)
 
         def harvest_blocks(blks):
             """Device-major harvest of one spill event's blocks:
@@ -407,17 +428,18 @@ class SpilledShardedEngine(ShardedEngine):
             _hv.__exit__(None, None, None)
             return out
 
-        frontier: List[List] = [[] for _ in range(D)]
-        frontier_keys: List[List] = [[] for _ in range(D)]
-        root_front = harvest_blocks(root_blks)
-        self._flush_level_parts()
-        for d in range(D):
-            if root_front[d] is not None:
-                rows_r, gids_r, fk_r = root_front[d]
-                frontier[d].append((rows_r, gids_r))
-                if fk_r is not None:
-                    frontier_keys[d].append(fk_r)
-        res.generated_states = len(rk)
+        if not resumed:
+            frontier = [[] for _ in range(D)]
+            frontier_keys = [[] for _ in range(D)]
+            root_front = harvest_blocks(root_blks)
+            self._flush_level_parts()
+            for d in range(D):
+                if root_front[d] is not None:
+                    rows_r, gids_r, fk_r = root_front[d]
+                    frontier[d].append((rows_r, gids_r))
+                    if fk_r is not None:
+                        frontier_keys[d].append(fk_r)
+            res.generated_states = len(rk)
         if stop_on_violation and res.violations:
             res.seconds = time.perf_counter() - t0
             return res
@@ -429,15 +451,28 @@ class SpilledShardedEngine(ShardedEngine):
         burst_ok = True
         while any(frontier) and depth < max_depth and \
                 res.distinct_states < max_states:
+            # chaos site: dispatch-time device/tunnel error at the
+            # level boundary (resil/chaos) — before any device work,
+            # so the last checkpoint stays the exact resume point
+            chaos_point("dispatch")
             if (self.burst and burst_ok and not self.host_table and
                     max(sum(int(g.shape[0]) for _r, g in q)
                         for q in frontier) <= self._mesh_burst_width()):
+                d0 = depth
                 (carry, frontier, depth, n_states, n_vis,
                  fused, bailed) = self._burst_mesh_levels(
                     carry, frontier, res, depth, n_states, n_vis,
                     max_depth, max_states, verbose)
                 if fused:
                     burst_ok = not bailed
+                    # fire if ANY multiple of checkpoint_every was
+                    # crossed by the burst's multi-level depth jump
+                    every = max(1, checkpoint_every)
+                    if checkpoint_path is not None and \
+                            depth // every > d0 // every:
+                        self._save_spill_mesh_checkpoint(
+                            checkpoint_path, carry, res, frontier,
+                            frontier_keys, depth, n_states, n_vis)
                     if stop_on_violation and res.violations:
                         break
                     continue
@@ -541,6 +576,11 @@ class SpilledShardedEngine(ShardedEngine):
                 # its frontier's keys (the host partitions answer for
                 # everything archived)
                 carry, n_vis = self._reseed_shards(carry, frontier_keys)
+            if checkpoint_path is not None and \
+                    depth % max(1, checkpoint_every) == 0:
+                self._save_spill_mesh_checkpoint(
+                    checkpoint_path, carry, res, frontier,
+                    frontier_keys, depth, n_states, n_vis)
             obs.dispatch(
                 kind="level", depth=depth,
                 frontier=sum(int(g.shape[0])
@@ -556,6 +596,238 @@ class SpilledShardedEngine(ShardedEngine):
         res.depth = depth
         res.seconds = time.perf_counter() - t0
         return res
+
+    # -- checkpoint / resume (round 12, ROADMAP item-5 closure) --------
+    # At a level boundary the wavefront is host-reachable: frontier
+    # blocks are host numpy, the visited set is the device shards (one
+    # pooled sparse fetch) or the per-device host partitions.  The file
+    # stores the wavefront POOLED in gid order — the portable form —
+    # because hash ownership (key[W-1] % D) is a pure function of
+    # content: resume re-routes rows and keys to their owners, which
+    # reproduces the original per-device assignment exactly on the same
+    # mesh, and re-partitions it on any other shape via resume_image.
+    # The device-table slot layout is NOT serialized (membership is a
+    # set property; rebuilt images dedup identically), and under
+    # host_table the device cache resumes reseeded to the frontier's
+    # keys — a state the engine itself produces at reseed boundaries,
+    # so counts/gids/archives stay bit-exact (tests/test_resil.py).
+    # ------------------------------------------------------------------
+
+    _SM_EXTRA_KEYS = ("D", "LB", "VB", "FC", "SC", "fam_caps",
+                      "host_table", "partitions")
+    _SM_FMT = ("sm_format", 1,
+               "the spill-mesh pooled-wavefront layout")
+
+    def _pool_frontier(self, frontier, frontier_keys):
+        """Per-device frontier queues -> (rows batch-major, gids,
+        fkeys) pooled in global-id order (fkeys None outside
+        host-table mode)."""
+        rows_l, gids_l, keys_l = [], [], []
+        for d in range(self.D):
+            blocks = frontier[d]
+            kq = (frontier_keys[d] if self.host_table
+                  else [None] * len(blocks))
+            for bi, (rows, gids) in enumerate(blocks):
+                rows_l.append(rows)
+                gids_l.append(gids)
+                keys_l.append(kq[bi])
+        if gids_l:
+            g = np.concatenate(gids_l)
+            order = np.argsort(g, kind="stable")
+            keys0 = rows_l[0].keys()
+            pf_rows = {k: np.ascontiguousarray(np.concatenate(
+                [r[k] for r in rows_l])[order]) for k in keys0}
+            pf_g = g[order].astype(np.int32)
+            pfk = (np.concatenate(keys_l)[order].astype(np.uint32)
+                   if self.host_table else None)
+            return pf_rows, pf_g, pfk
+        one = self.ir.narrow(self.lay, self.ir.encode(
+            self.lay, *self.ir.init_state(self.cfg)))
+        pf_rows = {k: np.zeros((0,) + v.shape, v.dtype)
+                   for k, v in one.items()}
+        return (pf_rows, np.zeros((0,), np.int32),
+                np.zeros((0, self.W), np.uint32)
+                if self.host_table else None)
+
+    def _save_spill_mesh_checkpoint(self, path, carry, res, frontier,
+                                    frontier_keys, depth, n_states,
+                                    n_vis):
+        with self._obs.span("checkpoint"):
+            from ..resil.portable import dense_table_keys
+            D, W = self.D, self.W
+            ckpt = {}
+            pf_rows, pf_g, pfk = self._pool_frontier(frontier,
+                                                     frontier_keys)
+            ckpt["pf|g"] = pf_g
+            for k, v in pf_rows.items():
+                ckpt[f"pf|rows|{k}"] = v
+            if self.host_table:
+                ckpt["pfk"] = pfk
+                for d in range(D):
+                    ckpt.update(self.hpts[d].state_dict(
+                        prefix=f"hpt{d}"))
+            else:
+                vis_np = [np.asarray(t) for t in carry["vis"]]
+                ckpt["keys"] = dense_table_keys(vis_np)
+            parents, lanes, states, arch_meta = self._ckpt_store_args()
+            ckpt_write(path, ckpt, self.store_states, parents, lanes,
+                       states, res, dict(
+                           spill=True, sharded=True, sm_format=1,
+                           D=D, W=W, host_table=self.host_table,
+                           partitions=self.partitions,
+                           depth=depth, n_states=n_states,
+                           n_vis=[int(x) for x in n_vis],
+                           n_front=int(pf_g.shape[0]),
+                           LB=self.LB, VB=self.VB, FC=self.FC,
+                           SC=self.SC,
+                           fam_caps=list(self.FAM_CAPS), **arch_meta,
+                           layout=2, chunk=self.chunk,
+                           spec=self.ir.name,
+                           ir_fingerprint=self.ir.fingerprint(),
+                           cfg=repr(self.cfg)),
+                       keep=self.ckpt_keep)
+
+    def _load_spill_mesh_checkpoint(self, path):
+        z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
+                            self._SM_EXTRA_KEYS, sharded=True,
+                            spill=True, expected_format=self._SM_FMT,
+                            spec_name=self.ir.name)
+        if meta["D"] != self.D:
+            raise CheckpointError(
+                f"checkpoint was written on a {meta['D']}-device "
+                f"mesh; this engine has {self.D} devices — exact "
+                "resume needs the same mesh, or re-partition with a "
+                "portable resume (resume_image / --resume-portable)")
+        if bool(meta.get("host_table")) != self.host_table:
+            raise CheckpointError(
+                f"{path}: checkpoint was written with host_table="
+                f"{bool(meta.get('host_table'))}; resume with the "
+                "same setting")
+        if self.host_table and meta["partitions"] != self.partitions:
+            raise CheckpointError(
+                f"{path}: checkpoint has {meta['partitions']} "
+                f"host-table partitions; engine has "
+                f"{self.partitions} — resume with the same "
+                "--partitions (counts are P-invariant, but the "
+                "serialized images are not)")
+        # capacities restore so segmentation — and therefore spill
+        # event boundaries, row order and gid assignment — match the
+        # interrupted run exactly
+        self.LB = int(meta["LB"])
+        self.VB = int(meta["VB"])
+        self.FC = int(meta["FC"])
+        self.SC = int(meta["SC"])
+        self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])
+        rows = {}
+        for nm in z.files:
+            if nm.startswith("carry|pf|rows|"):
+                rows[nm.split("|", 3)[3]] = np.asarray(z[nm])
+        gids = np.asarray(z["carry|pf|g"]).astype(np.int32)
+        if self.host_table:
+            fkeys = np.asarray(z["carry|pfk"]).astype(np.uint32)
+            self.hpts = [HostPartitionedTable.from_state(
+                (lambda nm, _d=d: z["carry|" + nm]),
+                prefix=f"hpt{d}") for d in range(self.D)]
+            keys = None
+        else:
+            fkeys = None
+            keys = np.asarray(z["carry|keys"]).astype(np.uint32)
+        template = {"lvl": rows}
+        self._load_archives(path, z, meta, template)
+        self._cur_parts = []
+        res = ckpt_result(z, meta)
+        (carry, frontier, frontier_keys,
+         n_vis) = self._restore_wavefront(keys, rows, gids, fkeys,
+                                          exact_vb=True)
+        z.close()
+        return (carry, res, frontier, frontier_keys,
+                meta["n_states"], n_vis, meta["depth"])
+
+    def _resume_portable(self, img):
+        """Shape-portable resume: re-partition a PortableImage (from
+        ANY engine family / mesh size) onto this mesh — visited keys
+        and frontier rows re-route by hash ownership; under host_table
+        the archive set re-sweeps into fresh per-device partitions
+        (any --partitions works)."""
+        from ..resil.portable import validate_image
+        validate_image(img, self.ir.name, repr(self.cfg), self.W)
+        self._restore_portable_archives(img)
+        self._cur_parts = []
+        rows, gids = img.expandable()
+        keys = img.keys.astype(np.uint32)
+        if self.host_table:
+            self.hpts = [HostPartitionedTable(
+                self.W, partitions=self.partitions,
+                part_cap=self.part_cap) for _ in range(self.D)]
+            owner = keys[:, self.W - 1].astype(np.int64) % self.D
+            step = 1 << 16
+            for d in range(self.D):
+                kd = keys[owner == d]
+                for i in range(0, kd.shape[0], step):
+                    self.hpts[d].sweep(
+                        np.ascontiguousarray(kd[i:i + step]))
+            keys = None
+        (carry, frontier, frontier_keys,
+         n_vis) = self._restore_wavefront(keys, rows, gids, None)
+        return (carry, img.fresh_result(), frontier, frontier_keys,
+                img.n_states, n_vis, img.depth)
+
+    def _restore_wavefront(self, keys, rows, gids, fkeys,
+                           exact_vb=False):
+        """Pooled wavefront -> this mesh's per-device state: route
+        frontier rows (and, non-host-table, the visited keys) to their
+        hash owners, rebuild per-device table images with the host
+        insert twin, and return (carry, frontier, frontier_keys,
+        n_vis).  Under host_table the device shards reseed with the
+        frontier's keys only — exactly the reseed-boundary state; the
+        partitions (restored or re-swept by the caller) answer for
+        everything archived."""
+        D, W = self.D, self.W
+        if gids.shape[0] and fkeys is None:
+            b = {k: jnp.asarray(v)
+                 for k, v in self.ir.widen(rows).items()}
+            fkeys = np.asarray(self._rootfp_jit(b)).astype(np.uint32)
+        frontier: List[List] = [[] for _ in range(D)]
+        frontier_keys: List[List] = [[] for _ in range(D)]
+        if gids.shape[0]:
+            fowner = fkeys[:, W - 1].astype(np.int64) % D
+            for d in range(D):
+                idx = np.nonzero(fowner == d)[0]
+                if len(idx):
+                    frontier[d].append((
+                        {k: np.ascontiguousarray(v[idx])
+                         for k, v in rows.items()},
+                        gids[idx].astype(np.int32)))
+                    if self.host_table:
+                        frontier_keys[d].append(
+                            np.ascontiguousarray(fkeys[idx]))
+        if self.host_table:
+            key_src = [np.concatenate(q) if q
+                       else np.zeros((0, W), np.uint32)
+                       for q in frontier_keys]
+        else:
+            owner = keys[:, W - 1].astype(np.int64) % D
+            key_src = [np.ascontiguousarray(keys[owner == d])
+                       for d in range(D)]
+        n_vis = np.array([k.shape[0] for k in key_src], np.int64)
+        if not exact_vb:
+            if self.host_table:
+                self.VB = self.VB0
+            while int(n_vis.max(initial=0)) + self.LB > \
+                    self._LOAD_MAX * self.VB:
+                self.VB *= 4
+        carry = self._fresh_sharded_carry()
+        vis_np = [np.full((D, self.VB), np.uint32(0xFFFFFFFF),
+                          np.uint32) for _ in range(W)]
+        for d in range(D):
+            if key_src[d].shape[0]:
+                img = np.full((W, self.VB), np.uint32(0xFFFFFFFF),
+                              np.uint32)
+                insert_np(img, key_src[d])
+                for w in range(W):
+                    vis_np[w][d] = img[w]
+        carry["vis"] = tuple(jnp.asarray(v) for v in vis_np)
+        return carry, frontier, frontier_keys, n_vis
 
     # -- trace-archive composition ------------------------------------
 

@@ -11,8 +11,9 @@ JSONL format.
 from .batch import (BatchReport, BucketEngine, JobOutcome, run_jobs)
 from .cache import ResultCache
 from .jobs import Job, job_from_dict, load_jobs
+from .wavestate import WaveStateStore
 
 __all__ = [
     "BatchReport", "BucketEngine", "Job", "JobOutcome", "ResultCache",
-    "job_from_dict", "load_jobs", "run_jobs",
+    "WaveStateStore", "job_from_dict", "load_jobs", "run_jobs",
 ]

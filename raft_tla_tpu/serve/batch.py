@@ -45,9 +45,11 @@ import numpy as np
 
 from ..obs import NULL_OBS
 from ..obs.metrics import check_stats
+from ..resil.chaos import chaos_point
 from ..spec import C_OVERFLOW, spec_of
 from ..utils import take_arrays as _take
 from .jobs import Job
+from .wavestate import WaveStateStore
 
 U32MAX_NP = np.uint32(0xFFFFFFFF)
 
@@ -93,6 +95,12 @@ class _JobRun:
         self.live = True
         self.fallback = False
         self.fallback_reason: Optional[str] = None
+        # preemption / resume (round 12): a carry slice to enter the
+        # next wave with instead of root admission — set by a wave
+        # yield (parked) or a wave-state restore (resumed)
+        self.preinit: Optional[Dict] = None
+        self.parked = False
+        self.resumed = False
 
     def finish(self):
         self.live = False
@@ -106,8 +114,81 @@ class _JobRun:
 
     @property
     def status(self) -> str:
-        return "running" if self.live else \
-            ("fallback" if self.fallback else "done")
+        if self.live:
+            return "parked" if self.parked else "running"
+        return "fallback" if self.fallback else "done"
+
+    # -- wave-state (de)hydration (serve/wavestate) --------------------
+
+    def book(self) -> Dict:
+        res = self.res
+        return dict(
+            cache_key=self.job.cache_key(), label=self.job.label,
+            depth=int(self.depth), n_states=int(self.n_states),
+            n_front=int(self.n_front),
+            distinct=int(res.distinct_states),
+            generated=int(res.generated_states),
+            faults=int(res.overflow_faults),
+            viol_global=int(res.violations_global),
+            levels_fused=int(res.levels_fused),
+            burst_dispatches=int(res.burst_dispatches),
+            burst_bailouts=int(res.burst_bailouts),
+            level_sizes=[int(x) for x in res.level_sizes],
+            violations=[[v.invariant, int(v.state_id)]
+                        for v in res.violations],
+            n_arch=len(self.parents))
+
+    def wave_arrays(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for nm in ("fm", "gd", "vis"):
+            out[nm] = self.preinit[nm]
+        for k, v in self.preinit["fr"].items():
+            out[f"fr|{k}"] = v
+        out["cursors"] = np.array(
+            [self.preinit["nf"], self.preinit["g"],
+             self.preinit["pg"]], np.int64)
+        for i, (p, ln) in enumerate(zip(self.parents, self.lanes)):
+            out[f"par|{i}"] = p
+            out[f"lane|{i}"] = ln
+            for k, v in self.states[i].items():
+                out[f"st|{i}|{k}"] = v
+        return out
+
+    @classmethod
+    def from_wave_state(cls, job: Job, arrays: Dict, book: Dict
+                        ) -> "_JobRun":
+        from ..engine.bfs import Violation
+        run = cls(job)
+        run.resumed = True
+        run.depth = int(book["depth"])
+        run.n_states = int(book["n_states"])
+        run.n_front = int(book["n_front"])
+        res = run.res
+        res.distinct_states = int(book["distinct"])
+        res.generated_states = int(book["generated"])
+        res.overflow_faults = int(book["faults"])
+        res.violations_global = int(book["viol_global"])
+        res.levels_fused = int(book["levels_fused"])
+        res.burst_dispatches = int(book["burst_dispatches"])
+        res.burst_bailouts = int(book["burst_bailouts"])
+        res.level_sizes = [int(x) for x in book["level_sizes"]]
+        for inv, sid in book["violations"]:
+            res.violations.append(Violation(str(inv), int(sid)))
+        fr = {nm.split("|", 1)[1]: arrays[nm] for nm in arrays
+              if nm.startswith("fr|")}
+        cur = arrays["cursors"]
+        run.preinit = dict(fr=fr, fm=arrays["fm"], vis=arrays["vis"],
+                           gd=arrays["gd"], nf=int(cur[0]),
+                           g=int(cur[1]), pg=int(cur[2]))
+        n_arch = int(book.get("n_arch", 0))
+        st_keys = sorted({nm.split("|", 2)[2] for nm in arrays
+                          if nm.startswith("st|0|")})
+        for i in range(n_arch):
+            run.parents.append(arrays[f"par|{i}"])
+            run.lanes.append(arrays[f"lane|{i}"])
+            run.states.append({k: arrays[f"st|{i}|{k}"]
+                               for k in st_keys})
+        return run
 
 
 class JobOutcome:
@@ -346,6 +427,10 @@ class BucketEngine:
         import jax.numpy as jnp
         eng = self.eng
         JP = len(inits)
+        # gd/pg default to the fresh-start values (root gids are the
+        # ring prefix; no previous level); a restored/parked init
+        # carries its real cursors (wave-state resume, round 12)
+        gd0 = np.arange(self.KB, dtype=np.int32)
         return dict(
             vis=tuple(jnp.asarray(np.stack([it["vis"][w]
                                             for it in inits]))
@@ -354,31 +439,64 @@ class BucketEngine:
             fr={k: jnp.asarray(np.stack([it["fr"][k] for it in inits]))
                 for k in inits[0]["fr"]},
             fm=jnp.asarray(np.stack([it["fm"] for it in inits])),
-            gd=jnp.tile(jnp.arange(self.KB, dtype=jnp.int32)[None],
-                        (JP, 1)),
+            gd=jnp.asarray(np.stack([
+                np.asarray(it.get("gd", gd0), np.int32)
+                for it in inits])),
             nf=jnp.asarray(np.array([it["nf"] for it in inits],
                                     np.int32)),
             g=jnp.asarray(np.array([it["g"] for it in inits],
                                    np.int32)),
-            pg=jnp.zeros((JP,), jnp.int32),
+            pg=jnp.asarray(np.array([int(it.get("pg", 0))
+                                     for it in inits], np.int32)),
         )
+
+    def _job_slice(self, jst, k: int) -> Dict:
+        """One job's lane of the batched carry -> a host init dict
+        (the _stack/_admit format plus gd/pg) — the parkable/
+        persistable per-job wave state."""
+        eng = self.eng
+        return dict(
+            fr={nm: np.asarray(v[k]) for nm, v in jst["fr"].items()},
+            fm=np.asarray(jst["fm"][k]),
+            vis=np.stack([np.asarray(jst["vis"][w][k])
+                          for w in range(eng.W)]),
+            gd=np.asarray(jst["gd"][k]),
+            nf=int(np.asarray(jst["nf"][k])),
+            g=int(np.asarray(jst["g"][k])),
+            pg=int(np.asarray(jst["pg"][k])))
 
     # -- the wave driver -----------------------------------------------
 
     def run_wave(self, runs: List[_JobRun], obs, meta: Dict,
                  jobs_ctx: Optional[Dict] = None,
-                 verbose: bool = False):
-        """Run up to a wave of jobs to completion through the batched
-        burst.  Mutates the runs in place; jobs that bail are marked
-        for the sequential fallback.  ``jobs_ctx`` is the batch-global
-        per-job status map (heartbeat payload) this wave merges its
-        own statuses into."""
+                 verbose: bool = False,
+                 max_steps: Optional[int] = None,
+                 wave_state: Optional[WaveStateStore] = None):
+        """Run up to a wave of jobs through the batched burst.
+        Mutates the runs in place; jobs that bail are marked for the
+        sequential fallback.  ``jobs_ctx`` is the batch-global per-job
+        status map (heartbeat payload) this wave merges its own
+        statuses into.
+
+        ``max_steps`` — preemption (round 12): after that many batched
+        device calls, still-live jobs PARK (their carry slice moves to
+        ``run.preinit``) and the wave returns, yielding the lanes to
+        waiting jobs; the driver re-enters parked runs in a later
+        wave.  ``wave_state`` persists every live job's slice at each
+        wave boundary, so a killed process resumes stragglers
+        mid-BFS."""
         import jax.numpy as jnp
         eng = self.eng
         with obs.span("job_admit"):
             admitted = []
             for run in runs:
-                init = self._admit(run)
+                if run.preinit is not None:
+                    # parked/restored job: enter with its carry slice,
+                    # not root admission (counters already accrued)
+                    init, run.preinit = run.preinit, None
+                    eng._stamp_mode(run.res)
+                else:
+                    init = self._admit(run)
                 if init is not None:
                     admitted.append((run, init))
         if not any(run.live for run, _ in admitted):
@@ -390,7 +508,12 @@ class BucketEngine:
         inits = [init for _run, init in admitted]
         inits += [self._pad_init()] * (JP - len(admitted))
         jst = self._stack(inits)
+        steps = 0
         while any(run.live for run, _ in admitted):
+            # chaos site: dispatch-time device/tunnel error on the
+            # batched program (the batch-level --retries re-runs the
+            # job list; cache + wave state make the retry incremental)
+            chaos_point("dispatch")
             lv = np.zeros((JP,), np.int32)
             cap = np.ones((JP,), np.int32)
             for k, (run, _) in enumerate(admitted):
@@ -429,6 +552,32 @@ class BucketEngine:
                         {nm: np.asarray(v[k])
                          for nm, v in out["st"].items()}
                         if need else None)
+            steps += 1
+            if wave_state is not None:
+                # wave boundary: persist every still-live job's carry
+                # slice + bookkeeping, so a kill between here and the
+                # next boundary resumes mid-BFS (finished jobs are
+                # covered by the result cache instead)
+                with obs.span("wave_persist"):
+                    for k, (run, _) in enumerate(admitted):
+                        if run.live:
+                            run.preinit = self._job_slice(jst, k)
+                            wave_state.save(run.job.cache_key(),
+                                            run.wave_arrays(),
+                                            run.book())
+                            run.preinit = None
+            # chaos site: the deterministic SIGKILL stand-in — fires
+            # AFTER the persist, exactly like a kill at the boundary
+            chaos_point("wave_kill")
+            if max_steps is not None and steps >= max_steps and \
+                    any(run.live for run, _ in admitted):
+                # preemption: park the stragglers' carry slices and
+                # yield the lanes to waiting jobs; the driver requeues
+                # parked runs into a later wave
+                for k, (run, _) in enumerate(admitted):
+                    if run.live:
+                        run.preinit = self._job_slice(jst, k)
+                        run.parked = True
             live_runs = [run for run, _ in admitted]
             jobs_map = dict(jobs_ctx or {})
             jobs_map.update(_jobs_map(live_runs))
@@ -450,6 +599,8 @@ class BucketEngine:
                 print(f"batch wave: {done}/{len(live_runs)} jobs done, "
                       f"max depth "
                       f"{max((r.depth for r in live_runs), default=0)}")
+            if any(run.parked for run, _ in admitted):
+                break
 
     def _harvest(self, run: _JobRun, sj, par_j, lane_j, inv_j, st_j):
         """One job's slice of a batched call — the solo burst harvest,
@@ -546,7 +697,9 @@ def _run_solo(job: Job, obs, meta: Dict, status: str,
 
 def run_jobs(jobs: List[Job], cache=None, obs=None,
              sequential: bool = False, bucket_overrides=None,
-             verbose: bool = False) -> BatchReport:
+             verbose: bool = False, wave_state=None,
+             wave_yield: Optional[int] = None,
+             max_wave: Optional[int] = None) -> BatchReport:
     """Serve a job list: cache lookups, shape-bucket grouping, batched
     waves, sequential fallbacks, cache fill.  Returns a BatchReport
     with outcomes in submission order.
@@ -554,12 +707,32 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
     sequential=True skips the batched path entirely (one solo Engine
     per job) — the honest A/B reference bench.py records.
     bucket_overrides overrides the per-spec bucket params (tests force
-    tiny rings with it to exercise the fallback)."""
+    tiny rings with it to exercise the fallback).
+
+    Round 12 (preemptible waves): jobs schedule by descending
+    ``Job.priority`` (stable on submission order); ``wave_yield=N``
+    makes a wave yield its lanes after N batched device calls while
+    other jobs wait — stragglers PARK their carry and continue in a
+    later wave.  ``wave_state`` (a WaveStateStore or directory path)
+    persists every live job's carry at wave boundaries and resumes
+    jobs from it on the next invocation, so a killed run continues
+    finished jobs from the result cache and stragglers mid-BFS —
+    bit-exact per job.  ``max_wave`` overrides the jobs-per-wave
+    ceiling (default 8; tests shrink it to force parking)."""
     obs = obs if obs is not None else NULL_OBS
     t0 = time.perf_counter()
+    if isinstance(wave_state, str):
+        wave_state = WaveStateStore(wave_state)
+    if wave_yield is not None and int(wave_yield) < 1:
+        raise ValueError(f"wave_yield must be >= 1 "
+                         f"(got {wave_yield})")
+    wave_cap = int(max_wave) if max_wave is not None else _MAX_WAVE
+    if wave_cap < 1:
+        raise ValueError(f"max_wave must be >= 1 (got {max_wave})")
     meta = dict(jobs=len(jobs), cache_hits=0, buckets=0,
                 engines_compiled=0, batch_dispatches=0,
-                fallback_jobs=0, sequential=bool(sequential))
+                fallback_jobs=0, sequential=bool(sequential),
+                resumed_jobs=0, parked_waves=0)
     # labels key the heartbeat/watch job map and the report rows —
     # empty ones get positional names, duplicates get #N suffixes so
     # two same-labeled jobs never collapse into one watch line.  (The
@@ -604,6 +777,24 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
             pending.append(i)
     meta["deduped"] = len(dup_of)
     solo: List[Tuple[int, str, Optional[str]]] = []
+    # wave-state resume: a pending job with a persisted carry enters
+    # its wave mid-BFS instead of from the roots (a killed run's
+    # stragglers; finished jobs were answered by the cache above)
+    restored: Dict[int, _JobRun] = {}
+    if wave_state is not None and not sequential:
+        for i in pending:
+            hit = wave_state.load(jobs[i].cache_key())
+            if hit is None:
+                continue
+            arrays, book = hit
+            restored[i] = _JobRun.from_wave_state(jobs[i], arrays,
+                                                  book)
+            meta["resumed_jobs"] += 1
+            if obs.ledger is not None:
+                obs.ledger.record({
+                    "kind": "wave_resume", "label": jobs[i].label,
+                    "depth": int(book["depth"]),
+                    "distinct": int(book["distinct"])})
     if sequential:
         solo = [(i, "done", None) for i in pending]
     else:
@@ -626,14 +817,38 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
             buckets.setdefault(bkey, [ceiling, params, []])[2].append(i)
         meta["buckets"] = len(buckets)
         for bkey, (ceiling, params, idxs) in buckets.items():
+            from collections import deque
             be = BucketEngine(ceiling, **params)
             meta["engines_compiled"] += 1
-            for w0 in range(0, len(idxs), _MAX_WAVE):
-                wave = idxs[w0:w0 + _MAX_WAVE]
-                runs = [_JobRun(jobs[i]) for i in wave]
-                be.run_wave(runs, obs, meta, jobs_ctx=jobs_ctx,
-                            verbose=verbose)
+            # wave scheduling: priority first (stable on submission
+            # order), parked jobs requeue at the back — a long job
+            # yields its lane and continues in a later wave
+            queue = deque(sorted(
+                idxs, key=lambda i: (-jobs[i].priority, i)))
+            parked_runs: Dict[int, _JobRun] = {}
+            while queue:
+                wave = [queue.popleft()
+                        for _ in range(min(wave_cap, len(queue)))]
+                runs = []
+                for i in wave:
+                    run = parked_runs.pop(i, None) or \
+                        restored.pop(i, None) or _JobRun(jobs[i])
+                    run.parked = False
+                    runs.append(run)
+                be.run_wave(
+                    runs, obs, meta, jobs_ctx=jobs_ctx,
+                    verbose=verbose,
+                    max_steps=wave_yield if queue else None,
+                    wave_state=wave_state)
+                if any(run.parked for run in runs):
+                    # one increment per wave that yielded, however
+                    # many jobs parked in it (the key counts WAVES)
+                    meta["parked_waves"] += 1
                 for i, run in zip(wave, runs):
+                    if run.parked:
+                        parked_runs[i] = run
+                        queue.append(i)
+                        continue
                     if run.fallback:
                         solo.append((i, "fallback",
                                      run.fallback_reason))
@@ -648,8 +863,11 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
                                          archives=archives)
                     if job.store_states:
                         tracer = outcome.trace
+                    reason = ("resumed from wave state"
+                              if run.resumed else None)
                     outcome.report = _build_report(job, run.res,
                                                    "done",
+                                                   reason=reason,
                                                    tracer=tracer)
                     outcomes[i] = outcome
     meta["fallback_jobs"] = sum(1 for _i, st, _r in solo
@@ -681,6 +899,10 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
         if cache is not None:
             cache.put(outcome.report["cache_key"],
                       outcome.cache_payload())
+        if wave_state is not None:
+            # the job is answered (and cached): retire its mid-BFS
+            # carry so a future invocation never resumes stale state
+            wave_state.drop(outcome.report["cache_key"])
         _job_row(obs, outcome)
     return BatchReport(outcomes, meta,
                        seconds=time.perf_counter() - t0)

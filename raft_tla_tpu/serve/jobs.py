@@ -53,6 +53,10 @@ class Job:
     # seeded jobs to the sequential fallback; still cacheable (the
     # seed digest rides the options fingerprint)
     seed_states: Optional[List] = None
+    # wave-scheduling priority (higher runs first; round 12): a
+    # SCHEDULING property, not a result one — deliberately outside the
+    # options fingerprint so priority changes never miss the cache
+    priority: int = 0
 
     def __post_init__(self):
         if self.max_depth < 0:
@@ -97,7 +101,7 @@ class Job:
 # ---------------------------------------------------------------------------
 
 _TOP_KEYS = ("spec", "config", "overrides", "max_depth", "max_states",
-             "keep_going", "store", "label")
+             "keep_going", "store", "label", "priority")
 _RAFT_OVERRIDES = ("servers", "values", "max_inflight", "next",
                    "symmetry", "invariants", "bounds")
 _RAFT_BOUNDS = ("max_log_length", "max_restarts", "max_timeouts",
@@ -235,12 +239,18 @@ def job_from_dict(obj: Dict, where: str = "job") -> Job:
             raise ValueError(
                 f"{where}: {nm} must be a non-negative integer "
                 f"(got {v!r})")
+    prio = obj.get("priority", 0)
+    if isinstance(prio, bool) or not isinstance(prio, int):
+        raise ValueError(
+            f"{where}: priority must be an integer (higher runs "
+            f"first; got {prio!r})")
     return Job(cfg,
                max_depth=obj.get("max_depth", 10 ** 9),
                max_states=obj.get("max_states", 10 ** 9),
                stop_on_violation=not obj.get("keep_going", False),
                store_states=bool(obj.get("store", True)),
-               label=str(obj.get("label", "")))
+               label=str(obj.get("label", "")),
+               priority=prio)
 
 
 def load_jobs(path: str) -> List[Job]:

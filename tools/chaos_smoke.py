@@ -1,0 +1,120 @@
+"""CI chaos smoke: kill a tiny ``cli batch`` run mid-wave, re-invoke,
+assert bit-exact completion with a ledger that shows the resume.
+
+Three invocations of the real CLI (subprocesses, CPU-only):
+
+1. REFERENCE — the job list runs clean; its per-job reports are the
+   ground truth.
+2. KILL — the same jobs with ``--chaos wave_kill:at=1``: the
+   deterministic SIGKILL stand-in fires at the first wave boundary,
+   AFTER the per-job wave state persisted (serve/wavestate) — the run
+   exits non-zero mid-wave, exactly like a preempted process.
+3. RESUME — the same command again, no chaos: the straggler must
+   resume MID-BFS from its wave state (the ledger shows a
+   ``wave_resume`` record and the job row says "resumed from wave
+   state"), every job must finish, and counts/level_sizes must equal
+   the reference bit-for-bit.
+
+Also exercises: the result cache sharing a directory with the wave
+state, ``--retries`` self-healing (run 2's failure would have been
+absorbed by ``--retries 1`` — asserted via the in-process tests; here
+the two-invocation shape mirrors a real kill).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_batch(jobs_path, tmp, extra, expect_rc):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "raft_tla_tpu", "batch",
+           "--jobs", jobs_path,
+           "--cache-dir", os.path.join(tmp, "cache"),
+           "--wave-state", os.path.join(tmp, "waves"),
+           "--ledger", os.path.join(tmp, "ledger.jsonl"),
+           "--heartbeat", os.path.join(tmp, "hb.json")] + extra
+    p = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                       env=env, timeout=600)
+    assert p.returncode == expect_rc, \
+        (p.returncode, expect_rc, p.stdout, p.stderr)
+    rows = [json.loads(ln) for ln in p.stdout.splitlines() if ln]
+    return rows
+
+
+def ledger_records(tmp):
+    recs = []
+    with open(os.path.join(tmp, "ledger.jsonl")) as fh:
+        for line in fh:
+            recs.append(json.loads(line))
+    return recs
+
+
+def main():
+    raft_cfg = os.path.join(REPO, "configs", "tlc_membership",
+                            "raft.cfg")
+    jobs = [
+        {"spec": "raft", "config": raft_cfg, "label": "deep",
+         "max_depth": 14,
+         "overrides": {"servers": 2, "next": "NextAsync",
+                       "bounds": {"max_log_length": 1,
+                                  "max_timeouts": 1,
+                                  "max_client_requests": 1}}},
+        {"spec": "raft", "config": raft_cfg, "label": "short",
+         "max_depth": 3, "priority": 1,
+         "overrides": {"servers": 2, "next": "NextAsync",
+                       "bounds": {"max_log_length": 1,
+                                  "max_timeouts": 1,
+                                  "max_client_requests": 1}}},
+    ]
+    ref_tmp = tempfile.mkdtemp(prefix="chaos_smoke_ref_")
+    tmp = tempfile.mkdtemp(prefix="chaos_smoke_")
+    jobs_path = os.path.join(tmp, "jobs.jsonl")
+    with open(jobs_path, "w") as fh:
+        for obj in jobs:
+            fh.write(json.dumps(obj) + "\n")
+
+    # 1. clean reference
+    ref_rows = run_batch(jobs_path, ref_tmp, [], expect_rc=0)
+    ref = {r["label"]: r for r in ref_rows[1:]}
+    assert set(ref) == {"deep", "short"}, ref_rows
+
+    # 2. kill mid-wave (exit 3: the batch driver reports the injected
+    # fault as a failed run after 0 retries)
+    run_batch(jobs_path, tmp, ["--chaos", "wave_kill:at=1"],
+              expect_rc=3)
+    waves = os.listdir(os.path.join(tmp, "waves"))
+    assert any(nm.endswith(".wave.npz") for nm in waves), \
+        f"no wave state persisted before the kill: {waves}"
+
+    # 3. resume — every job completes, stragglers mid-BFS
+    rows = run_batch(jobs_path, tmp, [], expect_rc=0)
+    summary, per_job = rows[0], {r["label"]: r for r in rows[1:]}
+    assert summary["resumed_jobs"] >= 1, summary
+    resumed = [r for r in per_job.values()
+               if r.get("status_reason") == "resumed from wave state"]
+    assert resumed, per_job
+    for label, want in ref.items():
+        got = per_job[label]
+        assert got["status"] in ("done", "cache_hit"), got
+        for key in ("distinct_states", "generated_states", "depth",
+                    "level_sizes", "violations"):
+            assert got[key] == want[key], (label, key, got[key],
+                                           want[key])
+    recs = ledger_records(tmp)
+    assert any(r.get("kind") == "wave_resume" for r in recs), \
+        sorted({r.get("kind") for r in recs})
+    # wave state retired once the jobs finished
+    waves = [nm for nm in os.listdir(os.path.join(tmp, "waves"))
+             if nm.endswith(".wave.npz")]
+    assert not waves, waves
+    print("chaos smoke OK: killed mid-wave, resumed bit-exact "
+          f"(resumed_jobs={summary['resumed_jobs']})")
+
+
+if __name__ == "__main__":
+    main()

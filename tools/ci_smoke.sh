@@ -29,3 +29,7 @@ env JAX_PLATFORMS=cpu python tools/paxos_smoke.py
 # a re-run asserting the second invocation is served entirely from the
 # fingerprint-keyed result cache — 0 device dispatches in the ledger
 env JAX_PLATFORMS=cpu python tools/serve_smoke.py
+# fault-tolerance gate (round 12): kill a tiny `cli batch` run
+# mid-wave via the deterministic wave_kill chaos site, re-invoke, and
+# assert bit-exact completion with a ledger showing the wave resume
+env JAX_PLATFORMS=cpu python tools/chaos_smoke.py

@@ -13,9 +13,18 @@ Usage: python tools/deep_run.py CONFIG DEPTH [--spec raft|paxos]
        [--fp128] [--chunk N]
        [--seg N] [--vcap N] [--tag NAME] [--classic] [--lcap N]
        [--fcap N] [--native] [--budget N] [--ckpt FILE]
-       [--resume FILE] [--ckpt-every N] [--host-table]
+       [--resume FILE] [--ckpt-every N] [--ckpt-keep K]
+       [--retries N] [--backoff S] [--chaos SPEC] [--host-table]
        [--partitions P] [--part-cap N] [--ledger FILE]
        [--heartbeat FILE] [--trace-timeline FILE] [--profile-dir DIR]
+
+Fault tolerance (round 12, resil/): --retries N wraps the drive loop
+in the supervised runner — a dropped tunnel triggers backend reinit +
+resume from the newest valid member of the --ckpt chain (last
+--ckpt-keep checkpoints, sha256 sidecars) with bounded exponential
+backoff; attempts land in the ledger/heartbeat and tools/watch.py
+shows the backoff state.  --chaos injects deterministic faults at the
+named engine sites for recovery drills.
 
 Observability (obs/): --ledger appends one JSONL record per dispatch
 (flushed, so a dropped tunnel keeps the telemetry up to the last
@@ -90,6 +99,7 @@ def main():
     opts = dict(zip(args[::2], args[1::2]))
     known = {"--chunk", "--seg", "--vcap", "--budget", "--tag", "--lcap",
              "--fcap", "--ckpt", "--resume", "--ckpt-every",
+             "--ckpt-keep", "--retries", "--backoff", "--chaos",
              "--partitions", "--part-cap", "--burst-levels",
              "--ledger", "--heartbeat", "--trace-timeline",
              "--profile-dir", "--dedup-kernel", "--fam-cap-density",
@@ -171,18 +181,39 @@ def main():
             "seconds": round(nat.seconds, 2),
             "states_per_sec": round(nat.states_per_sec, 1)}
         print(json.dumps({"native": nat_rec}), flush=True)
-    if flags["--classic"]:
-        eng = Engine(cfg, chunk=chunk, store_states=False, vcap=vcap,
-                     lcap=int(opts.get("--lcap", 1 << 21)),
-                     fcap=int(opts["--fcap"]) if "--fcap" in opts
-                     else None,
-                     burst=burst, burst_levels=burst_levels, **mxu_kw)
-    else:
-        eng = SpillEngine(cfg, chunk=chunk, store_states=False, seg=seg,
-                          vcap=vcap, host_table=host_table,
-                          partitions=partitions, part_cap=part_cap,
-                          burst=burst, burst_levels=burst_levels,
-                          **mxu_kw)
+    retries = int(opts.get("--retries", 0))
+    backoff_s = float(opts.get("--backoff", 2.0))
+    ckpt_keep = int(opts.get("--ckpt-keep", 2))
+    if retries < 0 or backoff_s <= 0 or ckpt_keep < 1:
+        raise SystemExit("--retries must be >= 0, --backoff > 0, "
+                         "--ckpt-keep >= 1")
+    if "--chaos" in opts:
+        from raft_tla_tpu.resil.chaos import ChaosSpecError, install
+        try:
+            install(opts["--chaos"])
+        except ChaosSpecError as e:
+            raise SystemExit(str(e))
+
+    def build_engine():
+        if flags["--classic"]:
+            eng = Engine(cfg, chunk=chunk, store_states=False,
+                         vcap=vcap,
+                         lcap=int(opts.get("--lcap", 1 << 21)),
+                         fcap=int(opts["--fcap"]) if "--fcap" in opts
+                         else None,
+                         burst=burst, burst_levels=burst_levels,
+                         **mxu_kw)
+        else:
+            eng = SpillEngine(cfg, chunk=chunk, store_states=False,
+                              seg=seg, vcap=vcap,
+                              host_table=host_table,
+                              partitions=partitions,
+                              part_cap=part_cap,
+                              burst=burst, burst_levels=burst_levels,
+                              **mxu_kw)
+        eng.ckpt_keep = ckpt_keep
+        return eng
+    eng = build_engine()
     from raft_tla_tpu.obs import from_flags
     obs = from_flags(ledger=opts.get("--ledger"),
                      heartbeat=opts.get("--heartbeat"),
@@ -205,15 +236,29 @@ def main():
     if resume:
         # the checkpoint's distinct count: post-resume throughput is
         # (delta states)/secs — cumulative/partial would inflate the
-        # recorded rate ~10x on a late resume
-        meta = json.loads(str(np.load(resume)["meta"]))
+        # recorded rate ~10x on a late resume.  Read the same chain
+        # member the engine will (a torn head falls back to FILE.1,
+        # resil/ckpt_chain) — a bare head read here would traceback on
+        # exactly the torn-write case the chain exists for
+        from raft_tla_tpu.resil.ckpt_chain import latest_valid
+        src = latest_valid(resume) or resume
+        meta = json.loads(str(np.load(src)["meta"]))
         resume_start = int(meta["distinct"])
     t0 = time.perf_counter()
+    # supervised drive loop (resil/supervisor): the first attempt uses
+    # the already-warmed engine; retries rebuild it (backend reinit)
+    # and resume from the newest valid member of the --ckpt chain
+    from raft_tla_tpu.resil.supervisor import supervised_check
+    _warm = [eng]
+
+    def make_engine():
+        return _warm.pop() if _warm else build_engine()
     try:
-        r = eng.check(max_depth=depth, max_states=budget, verbose=True,
-                      checkpoint_path=ckpt,
-                      checkpoint_every=int(opts.get("--ckpt-every", 1)),
-                      resume_from=resume, obs=obs)
+        r, eng, attempts = supervised_check(
+            make_engine, retries=retries, backoff=backoff_s, obs=obs,
+            checkpoint_path=ckpt, resume_from=resume,
+            max_depth=depth, max_states=budget, verbose=True,
+            checkpoint_every=int(opts.get("--ckpt-every", 1)))
     except BaseException:
         obs.finish(status="failed")
         raise
@@ -252,6 +297,10 @@ def main():
         "dedup_kernel": int(r.dedup_kernel),
         "delta_matmul": int(r.delta_matmul),
         "resumed_from_checkpoint": bool(resume),
+        # supervised-retry provenance (round 12): a row produced over
+        # several attempts is labeled; its wall/rate fields cover the
+        # whole supervised session including backoff waits
+        "retry_attempts": int(attempts),
         "expected_fp_collisions": float(
             r.distinct_states ** 2 /
             2.0 ** ((128 if fp128 else 64) + 1)),
@@ -268,7 +317,7 @@ def main():
     if (spec == "raft" and not flags["--classic"] and conf_no == 2
             and depth == 19
             and rec["depth_exact"] and not fp128 and not resume
-            and not host_table):
+            and not host_table and attempts == 1):
         import jax
 
         from bench import perf_floor

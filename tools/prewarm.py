@@ -83,6 +83,32 @@ def warm_spill(tag, cfg, **kw):
     del eng
 
 
+def warm_resume(tag, cfg, **kw):
+    """Resume-repartition warm (round 12): checkpoint a depth-2 run,
+    load the portable image and resume it on the spill engine — this
+    exercises the resume-side executables a supervised recovery pays
+    mid-incident (the fresh-carry build, the table-image upload, the
+    repartitioned first level) so they land in the persistent cache
+    before the tunnel ever drops."""
+    import tempfile
+
+    from raft_tla_tpu.engine.bfs import Engine
+    from raft_tla_tpu.engine.spill import SpillEngine
+    from raft_tla_tpu.resil.portable import load_portable_image
+    t0 = time.time()
+    ck = os.path.join(tempfile.mkdtemp(prefix="prewarm_resil_"),
+                      "warm.ckpt")
+    eng = Engine(cfg, store_states=False, **kw)
+    eng.check(max_depth=2, checkpoint_path=ck, checkpoint_every=1)
+    eng.check(max_depth=3, resume_from=ck)           # native resume
+    img = load_portable_image(ck)
+    sp = SpillEngine(cfg, store_states=False, seg=1 << 14,
+                     chunk=kw.get("chunk", 256))
+    sp.check(max_depth=3, resume_image=img)          # repartition
+    print(f"{tag}: resume/repartition warmed in "
+          f"{time.time() - t0:.1f}s", flush=True)
+
+
 def main():
     from tools.measure_baseline import ENGINE_KW, build_cfg
 
@@ -114,6 +140,8 @@ def main():
         micro = micro.with_(n_servers=2, init_servers=(0, 1),
                             values=(1,), max_inflight_override=4)
         warm("bench micro gate", micro, chunk=256)
+        # the supervised-recovery path's executables (round 12)
+        warm_resume("resume repartition", micro, chunk=256)
         warm("bench headline", build_cfg(2), chunk=2048,
              lcap=bench.LCAP, vcap=bench.VCAP)
         # deep_run's spill probe shape, host table OFF and ON: the ON

@@ -11,7 +11,9 @@ throughput) and prints one status line per interval:
 
 A heartbeat older than ``--stale`` seconds (default 300 — a slow level
 on the tunneled runtime can legitimately take minutes) or a dead pid
-flags the run STALLED/DEAD.
+flags the run STALLED/DEAD.  A supervised run (``--retries``) in its
+backoff window renders RETRYING with the attempt counters instead —
+alive, not stalled — and a parked batch job shows status ``parked``.
 
 Multi-job mode: a batch heartbeat (``cli batch`` — the serving layer)
 carries a per-job status map; one extra line renders per job:
@@ -85,6 +87,7 @@ def status_line(hb_path, ledger_path, stale_s):
     age = time.time() - hb["last_dispatch_ts"]
     alive = pid_alive(int(hb["pid"]))
     finished = hb.get("status") == "finished"
+    backoff = hb.get("status") == "backoff"
     parts = [f"depth {hb['depth']}",
              f"{hb['states_enqueued']:,} states"]
     rate = None
@@ -106,6 +109,17 @@ def status_line(hb_path, ledger_path, stale_s):
     code = 0
     if finished:
         parts.append("FINISHED")
+    elif backoff and alive:
+        # supervised retry (resil/supervisor): the run hit a transient
+        # failure and is waiting out its backoff — alive and healthy,
+        # not stalled, however old the last dispatch is.  A DEAD pid
+        # still wins below: a run killed during its backoff window
+        # must flag DEAD, not an eternal RETRYING.
+        r = hb.get("retry") or {}
+        parts.append(
+            f"RETRYING attempt {r.get('attempt', '?')}/"
+            f"{r.get('max_attempts', '?')}, backoff "
+            f"{r.get('wait_s', '?')}s")
     elif not alive:
         parts.append(f"pid {hb['pid']} DEAD")
         code = 1
