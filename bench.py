@@ -579,6 +579,113 @@ def _batch_ab(out_path):
     return out
 
 
+def _ceiling_ab(out_path):
+    """Constant-ceiling serving A/B (BENCH round 12, ROADMAP item 1):
+    K=4 raft jobs with DISTINCT value bounds (max_timeouts ×
+    max_log_length at depth 13 — each job's reachable count differs,
+    so the runtime-bounds machinery is provably live, not coincidence)
+    run sequentially (K engines, K compiles) vs through ONE padded
+    bucket ceiling (one engine, ONE ``bucket_compile``, per-job guard
+    thresholds/lane masks/bounds as vmapped device data).  Before
+    round 13 this exact job list compiled K separate buckets — the
+    heterogeneous traffic missed the bucket cache entirely.
+
+    Correctness gate: every job's (counts, level sizes) must be
+    identical across modes AND the four jobs' counts must be four
+    DIFFERENT numbers; otherwise the file is labeled FAILED and the
+    headline gate trips.  CPU fallback labeling as in BENCH_r05+."""
+    import jax
+
+    from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+    from raft_tla_tpu.obs import Obs, SpanRecorder
+    from raft_tla_tpu.serve import Job, run_jobs
+    from raft_tla_tpu.spec import spec_of
+
+    BOUNDS = ((1, 1), (1, 2), (2, 1), (2, 2))
+    K = len(BOUNDS)
+    cfgs = [ModelConfig(
+        n_servers=2, init_servers=(0, 1), values=(1,),
+        next_family=NEXT_ASYNC, symmetry=True,
+        max_inflight_override=4,
+        bounds=Bounds.make(max_log_length=m, max_timeouts=t,
+                           max_client_requests=2))
+        for m, t in BOUNDS]
+    n_ceilings = len({repr(spec_of(c).serve_bucket(c)[0])
+                      for c in cfgs})
+
+    def mk_jobs():
+        return [Job(c, max_depth=13, label=f"b{m}x{t}")
+                for c, (m, t) in zip(cfgs, BOUNDS)]
+
+    rows, per_job, raw_secs = {}, {}, {}
+    for label, seq in (("sequential", True), ("bucketed", False)):
+        rec = SpanRecorder()
+        t0 = time.perf_counter()
+        rep = run_jobs(mk_jobs(), obs=Obs(spans=rec), sequential=seq)
+        secs = raw_secs[label] = time.perf_counter() - t0
+        per_job[label] = {
+            o.job.label: (int(o.res.distinct_states),
+                          int(o.res.generated_states),
+                          int(o.res.depth),
+                          tuple(int(x) for x in o.res.level_sizes))
+            for o in rep.outcomes}
+        rows[label] = {
+            "jobs": K,
+            "engines_compiled": rep.meta["engines_compiled"],
+            "buckets": rep.meta.get("buckets", 0),
+            "seconds": round(secs, 2),
+            "seconds_per_job": round(secs / K, 2),
+            "statuses": [o.status for o in rep.outcomes],
+            "phase_seconds": {nm: t["seconds"]
+                              for nm, t in rec.totals().items()},
+            "phase_counts": {nm: t["count"]
+                             for nm, t in rec.totals().items()},
+        }
+    identical = per_job["sequential"] == per_job["bucketed"]
+    counts = [v[0] for v in per_job["bucketed"].values()]
+    discriminated = len(set(counts)) == K
+    all_bucketed = all(s == "done"
+                       for s in rows["bucketed"]["statuses"])
+    one_compile = (n_ceilings == 1 and
+                   rows["bucketed"]["engines_compiled"] == 1 and
+                   rows["bucketed"]["phase_counts"].get(
+                       "bucket_compile", 0) == 1)
+    ok = identical and discriminated and all_bucketed and one_compile
+    speedup = raw_secs["sequential"] / max(raw_secs["bucketed"], 1e-9)
+    out = {
+        "bench": "constant-ceiling serving A/B: K=4 heterogeneous-"
+                 "bounds jobs sequential vs ONE padded bucket ceiling "
+                 "(bench.py, BENCH_r12 round)",
+        "platform": jax.default_backend(),
+        "honest_label": (
+            "CPU-only fallback: this container has no TPU; the "
+            "compile counts, bucket-hit behavior and result "
+            "identities are platform-independent, the seconds are "
+            "XLA:CPU"
+            if jax.default_backend() == "cpu" else "TPU-measured"),
+        "status": ("ok" if ok else
+                   "FAILED: padded-ceiling per-job results diverge "
+                   "from the sequential engines, do not discriminate "
+                   "by bounds, or compiled more than once — the perf "
+                   "rows are meaningless"),
+        "results_identical": identical,
+        "bounds_discriminate": discriminated,
+        "all_jobs_bucketed": all_bucketed,
+        "one_bucket_one_compile": one_compile,
+        "engines_compiled": {lbl: rows[lbl]["engines_compiled"]
+                             for lbl in rows},
+        "per_job_speedup": round(speedup, 2),
+        "rows": rows,
+        "per_job_counts": {lbl: list(v) for lbl, v in
+                           per_job["bucketed"].items()},
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, out_path)
+    return out
+
+
 def _no_reference_fallback():
     """Containers without the reference checkout (and without the TPU)
     cannot run the headline metric at all — emit ONE honestly-labeled
@@ -652,6 +759,10 @@ def _no_reference_fallback():
     delta_ab = _delta_ab(os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "BENCH_r11.json"))
     gate_ok = gate_ok and delta_ab["status"] == "ok"
+    # round 12: the constant-ceiling serving A/B rides the same gate
+    ceiling_ab = _ceiling_ab(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r12.json"))
+    gate_ok = gate_ok and ceiling_ab["status"] == "ok"
     print(json.dumps({
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
         "value": None, "unit": "states/sec", "vs_baseline": None,
@@ -685,7 +796,14 @@ def _no_reference_fallback():
                        "status": delta_ab["status"],
                        "states_per_sec": {
                            k: v["states_per_sec"]
-                           for k, v in delta_ab["rows"].items()}}}}))
+                           for k, v in delta_ab["rows"].items()}},
+                   "ceiling_ab": {
+                       "written_to": "BENCH_r12.json",
+                       "status": ceiling_ab["status"],
+                       "per_job_speedup":
+                           ceiling_ab["per_job_speedup"],
+                       "engines_compiled":
+                           ceiling_ab["engines_compiled"]}}}))
 
 
 def main():
@@ -789,6 +907,9 @@ def main():
     delta_ab = _delta_ab(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_r11.json"))
     gate_ok = gate_ok and delta_ab["status"] == "ok"
+    ceiling_ab = _ceiling_ab(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r12.json"))
+    gate_ok = gate_ok and ceiling_ab["status"] == "ok"
 
     # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------
     # Only meaningful for the full-depth run on the recorded machine
@@ -839,6 +960,7 @@ def main():
     out["detail"]["matmul_ab_status"] = matmul_ab["status"]
     out["detail"]["batch_ab_status"] = batch_ab["status"]
     out["detail"]["delta_ab_status"] = delta_ab["status"]
+    out["detail"]["ceiling_ab_status"] = ceiling_ab["status"]
     print(json.dumps(out))
 
 

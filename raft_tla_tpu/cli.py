@@ -796,7 +796,8 @@ def cmd_batch(args):
                                sequential=args.sequential,
                                verbose=args.verbose,
                                wave_state=args.wave_state,
-                               wave_yield=args.wave_yield)
+                               wave_yield=args.wave_yield,
+                               exec_cache=args.executable_cache)
                 done = True
                 break
             except RETRYABLE as e:
@@ -1133,6 +1134,16 @@ def main(argv=None):
                          "N bytes, least-recently-used payloads "
                          "first (default: unbounded, the historical "
                          "behavior)")
+    pb.add_argument("--executable-cache", default=None, metavar="DIR",
+                    help="persistent AOT executable cache (serve/"
+                         "exec_cache): bucket executables serialize "
+                         "to DIR around .lower().compile(), so a "
+                         "service restart re-loads them instead of "
+                         "re-paying the 30-50s TPU compiles; on a "
+                         "backend that cannot serialize executables "
+                         "every entry reads as a labeled miss "
+                         "(counted in the summary/ledger), never a "
+                         "crash")
     pb.add_argument("--sequential", action="store_true",
                     help="run each job on its own engine instead of "
                          "the batched path (the honest A/B reference "

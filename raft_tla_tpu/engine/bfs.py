@@ -555,6 +555,9 @@ class Engine:
         self._rehash_cache = {}
         self._phase1 = jax.jit(self._phase1_impl)
         self._phase2 = jax.jit(self._phase2_impl)
+        # runtime-bounds twin (traced only by the padded-ceiling
+        # serving path — solo checks never touch it)
+        self._phase2_rt = jax.jit(self._phase2_rt_impl)
         # NOTE: a multi-chunk dispatch (K chunk steps per device call
         # via fori_loop) was tried and MEASURED SLOWER on v5e (70k ->
         # 38k states/s at K=4): XLA copies the loop-carried level/table
@@ -620,14 +623,14 @@ class Engine:
         fp, act = jax.vmap(per_state)(svb, cand, ok)
         return ok & act, cand, fp
 
-    def _phase2_one(self, sv):
+    def _phase2_one(self, sv, rtb=None):
         der = self.kern.derived(sv)
         inv = jnp.stack([self.preds.invariant_fn(nm)(sv, der)
                          for nm in self.inv_names]) \
             if self.inv_names else jnp.ones((0,), bool)
         con = jnp.bool_(True)
         for nm in self.con_names:
-            con = con & self.preds.constraint_fn(nm)(sv, der)
+            con = con & self.preds.constraint_fn(nm)(sv, der, rtb)
         return inv, con
 
     def _phase2_impl(self, svb):
@@ -636,11 +639,23 @@ class Engine:
             {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()})
         return jnp.moveaxis(inv, -1, 0), con
 
-    def _phase2_T(self, svT):
+    def _phase2_rt_impl(self, svb, rtb):
+        """Batch-major twin taking a runtime-bounds vector (the padded-
+        ceiling serving path's root admission — serve/batch._admit)."""
+        inv, con = self._phase2_T(
+            {k: jnp.moveaxis(v, 0, -1) for k, v in svb.items()}, rtb)
+        return jnp.moveaxis(inv, -1, 0), con
+
+    def _phase2_T(self, svT, rtb=None):
         """Batch-LAST hot-path twin: inv [n_inv, B], con [B] (rows
         vmapped at -1 — the tiny per-state minor dims waste TPU vector
-        tiles batch-major, expand.materialize docstring)."""
-        return jax.vmap(self._phase2_one, in_axes=-1, out_axes=-1)(svT)
+        tiles batch-major, expand.materialize docstring).  ``rtb`` is
+        an optional per-JOB runtime search-bounds vector
+        (ops/vpredicates.runtime_bounds): constant across the state
+        batch, so it broadcasts (in_axes=None) — under the serving
+        layer's job-axis vmap it varies per job."""
+        return jax.vmap(self._phase2_one, in_axes=(-1, None),
+                        out_axes=-1)(svT, rtb)
 
     # ------------------------------------------------------------------
     # device-resident dedup primitives
@@ -832,7 +847,7 @@ class Engine:
     # fused per-chunk step (ONE device call per frontier chunk)
     # ------------------------------------------------------------------
 
-    def _expand_fp_chunk(self, sv, valid, fam_caps, FCAP):
+    def _expand_fp_chunk(self, sv, valid, fam_caps, FCAP, rt=None):
         """Shared front half of a chunk step (this engine's fused step
         and engine/spill's streamed step): guard-first expansion over
         the [B, A] lane grid, compaction of enabled lanes into the FCAP
@@ -849,12 +864,17 @@ class Engine:
         full per-term hash per PARENT, per-candidate deltas over the
         action family's touched positions — bit-identical to the
         direct path (tests/test_codec.py) at a fraction of the work on
-        wide-expansion configs."""
+        wide-expansion configs.
+
+        ``rt`` — the per-job runtime-thresholds dict (guard thresholds
+        + family lane mask as device data; expand.Expander docstring),
+        None outside the padded-ceiling serving path."""
         B, A = valid.shape[0], self.A      # B from the caller's batch:
         # the level burst expands a whole (small) frontier as one chunk
         N = B * A
         derb = self.expander.derived_batch_T(sv)
-        ok = lax.optimization_barrier(self.expander.guards_T(sv, derb))
+        ok = lax.optimization_barrier(
+            self.expander.guards_T(sv, derb, rt))
         okf = (ok & valid[:, None]).reshape(N)
 
         # compact enabled lanes into FCAP (ascending lane index =
@@ -1167,7 +1187,7 @@ class Engine:
 
     def _burst_core(self, vis, claims, fr, fm, gd, nf, g0, pg0,
                     fam_caps, levels_left, states_cap, fcap=None,
-                    ocap=None):
+                    ocap=None, rt=None):
         """The fused multi-level loop, over standalone ring-width
         buffers (no engine carry): fr/fm/gd are [..., KB]/[KB]/[KB]
         frontier rows (narrow, batch-last), membership mask and global
@@ -1228,7 +1248,7 @@ class Engine:
             valid = ((base + jnp.arange(B, dtype=jnp.int32)) <
                      st["nf"]) & fm_c
             cand_c, elive, fp, take, famx_c, n_e = \
-                self._expand_fp_chunk(sv, valid, fam_caps, FCAP)
+                self._expand_fp_chunk(sv, valid, fam_caps, FCAP, rt)
             bail = (n_e > FCAP) | jnp.any(
                 famx_c > jnp.asarray(fam_caps, jnp.int32))
             keys = tuple(jnp.where(elive, fp[w], U32MAX)
@@ -1274,7 +1294,8 @@ class Engine:
                     slot, mode="drop"))          # out row -> FCAP slot
             rows = lax.optimization_barrier(
                 {k: cand_c[k][..., oidx] for k in cand_c})
-            inv, con = self._phase2_T(rows)
+            inv, con = self._phase2_T(
+                rows, None if rt is None else rt["bounds"])
             rows_n = self.ir.narrow(self.lay, rows)
             # ring positions for the compacted rows: nl + row index
             oar = jnp.arange(OC, dtype=jnp.int32)
@@ -1391,6 +1412,16 @@ class Engine:
         and ``st_cap`` are per-job int32[J] depth/state gates (a
         finished job passes lv_left=0 and never re-enters the loop).
 
+        Constant-padding ceilings (round 13): an optional ``jst["rt"]``
+        carries per-job runtime data — guard thresholds int32[J, A],
+        family lane masks bool[J, A], and the search-bounds vector
+        int32[J, NB] — so heterogeneous small configs (differing
+        MaxTerm-style bounds, paxos ballot/value/instance counts) ride
+        ONE compiled ceiling program: the int8 guard matrix and delta
+        matrices stay shared per shape ceiling while each job's
+        thresholds/masks/bounds vmap as device data.  Absent, the
+        program is the historical baked-constant one, bit-identical.
+
         Under vmap the burst's while_loops run until EVERY job's cond
         is false, with per-job select masking: a finished job's state
         freezes (its lanes contribute no further table writes or
@@ -1404,12 +1435,19 @@ class Engine:
         Returns (jst', out) with out's stats matrix and per-level
         archives carrying the same leading [J] axis."""
         def one(st, lvl, cap):
+            rt = st.get("rt")
             stf, out = self._burst_core(
                 st["vis"], st["claims"], st["fr"], st["fm"], st["gd"],
-                st["nf"], st["g"], st["pg"], self.FAM_CAPS, lvl, cap)
+                st["nf"], st["g"], st["pg"], self.FAM_CAPS, lvl, cap,
+                rt=rt)
             nst = dict(vis=stf["vis"], claims=stf["claims"],
                        fr=stf["fr"], fm=stf["fm"], gd=stf["gd"],
                        nf=stf["nf"], g=stf["g"], pg=stf["pg"])
+            if rt is not None:
+                # rt is job-constant: pass it through the carry so the
+                # AOT executable's output tree matches its input tree
+                # (the serving layer re-feeds jst every device call)
+                nst["rt"] = rt
             return nst, out
         return jax.vmap(one)(jst, lv_left, st_cap)
 

@@ -499,25 +499,60 @@ class Expander:
             oks.append(ok.reshape(-1))
         return jnp.concatenate(oks)
 
-    def guards_T(self, svT, derT) -> jnp.ndarray:
+    # ---- runtime thresholds (the serving layer's constant-padding
+    # bucket ceilings, round 13).  The int8 guard matrix W is shared
+    # per SHAPE CEILING; what varies per job is runtime data:
+    #
+    #   rt["thr"]  int32 [A] — the per-lane threshold the matmul
+    #              accumulator compares against (today every job's
+    #              vector equals the ceiling's baked _gT — thresholds
+    #              are conjunct counts — but the compare consumes it as
+    #              DEVICE DATA, so a [J]-leading axis vmaps it per job
+    #              with zero retrace);
+    #   rt["mask"] bool [A] — the job's family lane mask: a padded
+    #              ceiling enumerates MORE lanes than a small job's
+    #              grid (paxos ballots/values/instances); masked lanes
+    #              read disabled before compaction, so the surviving
+    #              candidate stream is exactly the job's own
+    #              enumeration order.
+    #
+    # rt=None keeps the historical baked-constant trace bit-identical.
+
+    def runtime_thresholds(self):
+        """The ceiling's (thresholds, all-enabled mask) pair as host
+        arrays — the template a spec's ``serve_runtime`` hook starts
+        from when building a job's rt data."""
+        return (np.asarray(self._gT, np.int32).copy(),
+                np.ones((self.n_lanes,), bool))
+
+    def guards_T(self, svT, derT, rt=None) -> jnp.ndarray:
         """Batch-LAST frontier [..., B] -> ok [B, A]: every lane's
         enabling guard.  Dispatches to the MXU guard-matrix path
         (``guards_T_matmul``, default) or the historical vmapped
         per-lane sweep with the successor construction
-        dead-code-eliminated (``guard_matmul=False``)."""
+        dead-code-eliminated (``guard_matmul=False``).  ``rt`` is the
+        per-job runtime-thresholds dict above (None = baked
+        constants)."""
         if self.guard_matmul:
-            return self.guards_T_matmul(svT, derT)
+            return self.guards_T_matmul(svT, derT, rt)
         ok = jax.vmap(self._guard_one, in_axes=-1, out_axes=-1)(svT, derT)
-        return jnp.moveaxis(ok, -1, 0)
+        ok = jnp.moveaxis(ok, -1, 0)
+        if rt is not None:
+            # the sweep computes guards directly (no threshold
+            # compare), so only the lane mask applies here
+            ok = ok & rt["mask"][None, :]
+        return ok
 
-    def guards_T_matmul(self, svT, derT) -> jnp.ndarray:
+    def guards_T_matmul(self, svT, derT, rt=None) -> jnp.ndarray:
         """The guard grid as ONE int8 matmul: φ [F, B] features (one
         elementwise extraction pass per state — the per-slot receive
         guards run once per SLOT, not once per lane) contracted against
         the packed weight matrix on the MXU with int32 accumulation,
         then the exact per-lane threshold compare.  Bit-identical to
         the lane sweep by construction (integer arithmetic, 0/±1
-        weights)."""
+        weights).  With ``rt``, the thresholds are device data and the
+        job's lane mask ANDs in after the compare (see the
+        runtime-thresholds note above)."""
         with jax.named_scope("guard_matmul"):
             phi = jax.vmap(self.kern.guard_features,
                            in_axes=-1, out_axes=-1)(svT, derT)  # [F, B]
@@ -525,7 +560,9 @@ class Expander:
                 phi, jnp.asarray(self._gW),
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32)               # [B, A]
-            return acc == jnp.asarray(self._gT)[None, :]
+            if rt is None:
+                return acc == jnp.asarray(self._gT)[None, :]
+            return (acc == rt["thr"][None, :]) & rt["mask"][None, :]
 
     # ---- one-hot einsum selection (the successor-generation half of
     # the MXU path): a compacted (row, lane) index block becomes an

@@ -71,6 +71,7 @@ class Obs:
         self._t0 = time.perf_counter()
         self._n_dispatch = 0
         self._last_jobs = None
+        self._last_slo = None
         if profile_dir and spans is not None:
             # device traces only line up with the host timeline if the
             # TraceAnnotation names match the span names
@@ -92,14 +93,19 @@ class Obs:
     def dispatch(self, *, kind: str, depth: int, frontier: int = 0,
                  metrics: Optional[Dict] = None,
                  states: Optional[int] = None,
-                 jobs: Optional[Dict] = None):
+                 jobs: Optional[Dict] = None,
+                 slo: Optional[Dict] = None):
         """One record per dispatch (burst device call / per-level round
         trip / sim dispatch / batched multi-job call): ledger line +
         heartbeat rewrite.  ``jobs`` is the serving layer's per-job
         status map ({label: {depth, distinct, status}}): it rides the
         heartbeat so ``tools/watch.py`` renders one line per job, and
         the ledger record carries its live/total counts (full per-job
-        rows land as separate kind="job" records at job completion)."""
+        rows land as separate kind="job" records at job completion).
+        ``slo`` is the serving layer's SLO snapshot (queue depth,
+        wait/service-seconds histograms, exec-cache counters): it
+        rides the heartbeat next to the job map — watch renders the
+        queue line — and the ledger record carries queue_depth."""
         self._n_dispatch += 1
         metrics = metrics or {}
         if states is None:
@@ -131,19 +137,30 @@ class Obs:
                 rec["jobs_live"] = sum(
                     1 for j in jobs.values()
                     if j.get("status") == "running")
+            if slo is not None and "queue_depth" in slo:
+                rec["queue_depth"] = int(slo["queue_depth"])
             self.ledger.record(rec)
         if jobs is not None:
             self._last_jobs = jobs
+        if slo is not None:
+            self._last_slo = dict(slo)
         if self.heartbeat is not None:
+            extra = {}
+            if jobs is not None:
+                extra["jobs"] = jobs
+            if slo is not None:
+                extra["slo"] = dict(slo)
             self.heartbeat.beat(depth=depth, states=states,
-                                extra={"jobs": jobs}
-                                if jobs is not None else None)
+                                extra=extra or None)
 
-    def set_jobs(self, jobs: Dict):
-        """Update the per-job status map the final heartbeat carries
-        (the serving layer records cache hits and fallback/sequential
-        jobs here — they finish outside any batched dispatch)."""
+    def set_jobs(self, jobs: Dict, slo: Optional[Dict] = None):
+        """Update the per-job status map (and optionally the SLO
+        snapshot) the final heartbeat carries (the serving layer
+        records cache hits and fallback/sequential jobs here — they
+        finish outside any batched dispatch)."""
         self._last_jobs = dict(jobs)
+        if slo is not None:
+            self._last_slo = dict(slo)
 
     def retry(self, *, attempt: int, max_attempts: int, wait_s: float,
               error):
@@ -195,10 +212,13 @@ class Obs:
                 states=int(states if states is not None
                            else self.heartbeat.last_states),
                 status=status,
-                # a batch run's final beat keeps the per-job map, so
-                # watch renders the job lines next to FINISHED
-                extra={"jobs": self._last_jobs}
-                if self._last_jobs is not None else None)
+                # a batch run's final beat keeps the per-job map (and
+                # the SLO snapshot), so watch renders the job + queue
+                # lines next to FINISHED
+                extra=(({"jobs": self._last_jobs}
+                        if self._last_jobs is not None else {}) |
+                       ({"slo": self._last_slo}
+                        if self._last_slo is not None else {})) or None)
         if self.ledger is not None:
             self.ledger.close()
         if self.spans is not None:

@@ -77,6 +77,19 @@ class RaftKernels:
         self.Lcap = lay.Lcap
         self.K = lay.K
 
+    @property
+    def term_cap(self) -> int:
+        """The term REPRESENTABILITY clamp: the packing holds
+        max_terms + 1 (the one unconstrained step past BoundedTerms).
+        This is a property of the LAYOUT's bounds, deliberately NOT of
+        any per-job runtime bound: under a padded serving ceiling
+        (spec serve_bucket, round 13) the job's BoundedTerms rides the
+        runtime-bounds vector while this clamp stays at the ceiling's
+        width — exact, because constraint-pruned states are never
+        expanded, so an in-bounds job can never reach the clamp in
+        either layout."""
+        return self.cfg.bounds.max_terms + 1
+
     # ------------------------------------------------------------------
     # Derived per-state quantities (recomputed once per expansion)
     # ------------------------------------------------------------------
@@ -252,7 +265,7 @@ class RaftKernels:
         # clamp so the state stays representable (the sibling overflow
         # guards' contract) — reachable only when BoundedTerms is disabled
         # (e.g. the apalache variant cfg) with too small a Bounds.max_terms
-        cap = self.cfg.bounds.max_terms + 1
+        cap = self.term_cap
         overflow = sv["ct"][i] + 1 > cap
         sv2["ct"] = sv["ct"].at[i].set(
             jnp.minimum(sv["ct"][i] + 1, cap))
@@ -888,7 +901,9 @@ class RaftKernels:
         d_lcdcc = (jnp.maximum(feat[F_LCDCC], feat[F_OPEN_ADD]) -
                    feat[F_LCDCC])[None]
         # Timeout's clamped term bump: room == the exact increment
-        cap = self.cfg.bounds.max_terms + 1
+        # (term_cap: the layout's representability clamp, never a
+        # per-job runtime bound — see the property's docstring)
+        cap = self.term_cap
         ctroom = (sv["ct"] < cap).astype(jnp.int32)
         # ClientRequest append: llen room + the append-position one-hot
         crroom = (sv["llen"] < Lcap).astype(jnp.int32)

@@ -21,6 +21,8 @@ from typing import Callable, Dict
 
 import jax.numpy as jnp
 
+import numpy as np
+
 from ..config import (CANDIDATE, CONFIG_ENTRY, LEADER, MT_RVREQ, NIL,
                       ModelConfig)
 from .codec import (C_GLOBLEN, C_NLEADERS, C_NMC, C_NREQ, C_NTRIED,
@@ -29,6 +31,43 @@ from .codec import (C_GLOBLEN, C_NLEADERS, C_NMC, C_NREQ, C_NTRIED,
                     F_NJBL)
 from .kernels import RaftKernels, popcount
 from .layout import Layout, get_field
+
+
+# ---------------------------------------------------------------------------
+# Runtime search bounds (the serving layer's constant-padding ceilings,
+# round 13).  Every Bounded* constraint compares a state quantity
+# against ONE scalar from the model config; under a padded bucket
+# ceiling those scalars become per-job device data so heterogeneous
+# configs share one compiled program.  This table is the canonical
+# layout of that vector: ``runtime_bounds(cfg)`` packs a config's
+# bounds in RUNTIME_BOUND_KEYS order, and each Bounded* predicate reads
+# index RB_* when handed an ``rtb`` vector (None keeps the historical
+# baked-constant trace, bit-identical program).
+# ---------------------------------------------------------------------------
+
+RUNTIME_BOUND_KEYS = (
+    "max_inflight", "max_log_length", "max_restarts", "max_timeouts",
+    "max_terms", "max_client_requests", "max_tried_membership_changes",
+    "max_membership_changes", "max_trace")
+(RB_INFLIGHT, RB_LOGLEN, RB_RESTARTS, RB_TIMEOUTS, RB_TERMS, RB_NREQ,
+ RB_TRIED, RB_NMC, RB_TRACE) = range(len(RUNTIME_BOUND_KEYS))
+
+
+def runtime_bounds(cfg) -> np.ndarray:
+    """A config's search bounds as the int32 vector the runtime-bounds
+    predicates consume (RUNTIME_BOUND_KEYS order)."""
+    b = cfg.bounds
+    return np.array([
+        cfg.max_inflight, b.max_log_length, b.max_restarts,
+        b.max_timeouts, b.max_terms, b.max_client_requests,
+        b.max_tried_membership_changes, b.max_membership_changes,
+        b.max_trace], np.int32)
+
+
+def _rb(rtb, idx: int, static):
+    """One bound: the runtime vector's lane when present, else the
+    config constant (the historical trace, unchanged)."""
+    return static if rtb is None else rtb[idx]
 
 
 class Predicates:
@@ -192,8 +231,9 @@ class Predicates:
     # reachability, read from counter/feature lanes
     # ------------------------------------------------------------------
 
-    def bounded_trace(self, sv, der):
-        return sv["ctr"][C_GLOBLEN] <= self.cfg.bounds.max_trace
+    def bounded_trace(self, sv, der, rtb=None):
+        return sv["ctr"][C_GLOBLEN] <= \
+            _rb(rtb, RB_TRACE, self.cfg.bounds.max_trace)
 
     def first_become_leader(self, sv, der):
         return sv["ctr"][C_NLEADERS] < 1
@@ -260,35 +300,46 @@ class Predicates:
     # Constraints (raft.tla:1105-1137) — expansion gates
     # ------------------------------------------------------------------
 
-    def bounded_in_flight_messages(self, sv, der):
-        return jnp.sum(sv["cnt"]) <= self.cfg.max_inflight
+    def bounded_in_flight_messages(self, sv, der, rtb=None):
+        return jnp.sum(sv["cnt"]) <= \
+            _rb(rtb, RB_INFLIGHT, self.cfg.max_inflight)
 
     def bounded_request_vote(self, sv, der):
         mtype = get_field(sv["bag"][:, 0],
                           self.lay.header_shifts["mtype"]).astype(jnp.int32)
         return jnp.all(~((mtype == MT_RVREQ) & (sv["cnt"] > 1)))
 
-    def bounded_log_size(self, sv, der):
-        return jnp.all(sv["llen"] <= self.cfg.bounds.max_log_length)
+    def bounded_log_size(self, sv, der, rtb=None):
+        return jnp.all(sv["llen"] <=
+                       _rb(rtb, RB_LOGLEN,
+                           self.cfg.bounds.max_log_length))
 
-    def bounded_restarts(self, sv, der):
-        return jnp.all(sv["restarted"] <= self.cfg.bounds.max_restarts)
+    def bounded_restarts(self, sv, der, rtb=None):
+        return jnp.all(sv["restarted"] <=
+                       _rb(rtb, RB_RESTARTS,
+                           self.cfg.bounds.max_restarts))
 
-    def bounded_timeouts(self, sv, der):
-        return jnp.all(sv["timeout"] <= self.cfg.bounds.max_timeouts)
+    def bounded_timeouts(self, sv, der, rtb=None):
+        return jnp.all(sv["timeout"] <=
+                       _rb(rtb, RB_TIMEOUTS,
+                           self.cfg.bounds.max_timeouts))
 
-    def bounded_terms(self, sv, der):
-        return jnp.all(sv["ct"] <= self.cfg.bounds.max_terms)
+    def bounded_terms(self, sv, der, rtb=None):
+        return jnp.all(sv["ct"] <=
+                       _rb(rtb, RB_TERMS, self.cfg.bounds.max_terms))
 
-    def bounded_client_requests(self, sv, der):
-        return sv["ctr"][C_NREQ] <= self.cfg.bounds.max_client_requests
+    def bounded_client_requests(self, sv, der, rtb=None):
+        return sv["ctr"][C_NREQ] <= \
+            _rb(rtb, RB_NREQ, self.cfg.bounds.max_client_requests)
 
-    def bounded_tried_membership_changes(self, sv, der):
+    def bounded_tried_membership_changes(self, sv, der, rtb=None):
         return sv["ctr"][C_NTRIED] <= \
-            self.cfg.bounds.max_tried_membership_changes
+            _rb(rtb, RB_TRIED,
+                self.cfg.bounds.max_tried_membership_changes)
 
-    def bounded_membership_changes(self, sv, der):
-        return sv["ctr"][C_NMC] <= self.cfg.bounds.max_membership_changes
+    def bounded_membership_changes(self, sv, der, rtb=None):
+        return sv["ctr"][C_NMC] <= \
+            _rb(rtb, RB_NMC, self.cfg.bounds.max_membership_changes)
 
     def elections_uncontested(self, sv, der):
         return jnp.sum((sv["st"] == CANDIDATE).astype(jnp.int32)) <= 1
@@ -329,7 +380,20 @@ class Predicates:
         return INVARIANTS[name].__get__(self)
 
     def constraint_fn(self, name: str) -> Callable:
-        return CONSTRAINTS[name].__get__(self)
+        """Every returned callable is uniformly ``(sv, der, rtb=None)``:
+        bound-comparing constraints read the runtime-bounds vector when
+        one is passed (the padded-ceiling serving path), the rest
+        ignore it — so engine call sites thread ``rtb``
+        unconditionally."""
+        fn = CONSTRAINTS[name].__get__(self)
+        try:
+            import inspect
+            takes_rtb = "rtb" in inspect.signature(fn).parameters
+        except (TypeError, ValueError):       # pragma: no cover
+            takes_rtb = False
+        if takes_rtb:
+            return fn
+        return lambda sv, der, rtb=None: fn(sv, der)
 
     def action_fn(self, name: str) -> Callable:
         """ACTION_CONSTRAINT device form: (parent_sv, cand_sv) -> ok

@@ -10,10 +10,12 @@ JSONL format.
 
 from .batch import (BatchReport, BucketEngine, JobOutcome, run_jobs)
 from .cache import ResultCache
+from .exec_cache import ExecCache
 from .jobs import Job, job_from_dict, load_jobs
 from .wavestate import WaveStateStore
 
 __all__ = [
-    "BatchReport", "BucketEngine", "Job", "JobOutcome", "ResultCache",
+    "BatchReport", "BucketEngine", "ExecCache", "Job", "JobOutcome",
+    "ResultCache",
     "WaveStateStore", "job_from_dict", "load_jobs", "run_jobs",
 ]

@@ -101,6 +101,9 @@ class _JobRun:
         self.preinit: Optional[Dict] = None
         self.parked = False
         self.resumed = False
+        # SLO accounting (round 13): submission -> wave-entry seconds,
+        # stamped by the driver's _SloTracker
+        self.wait_s = 0.0
 
     def finish(self):
         self.live = False
@@ -321,6 +324,60 @@ def _jobs_map(runs: List[_JobRun]) -> Dict[str, Dict]:
 
 
 # ---------------------------------------------------------------------------
+# SLO accounting (ROADMAP item 1, round 13): per-job wait (submission ->
+# first wave entry) and service (wave entry -> answer) seconds, folded
+# into fixed-bucket histograms the heartbeat carries live and the
+# per-tenant ledger rollups summarize at batch end.
+# ---------------------------------------------------------------------------
+
+_SLO_EDGES = (0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0)
+
+
+def slo_histogram(seconds: List[float]) -> Dict[str, int]:
+    """Fixed log-ish latency buckets (cumulative-friendly: each key is
+    the bucket's inclusive upper edge, 'inf' catches the tail)."""
+    hist = {f"le_{e:g}": 0 for e in _SLO_EDGES}
+    hist["inf"] = 0
+    for s in seconds:
+        for e in _SLO_EDGES:
+            if s <= e:
+                hist[f"le_{e:g}"] += 1
+                break
+        else:
+            hist["inf"] += 1
+    return hist
+
+
+class _SloTracker:
+    """The batch-global SLO state ``run_jobs`` maintains: submission
+    timestamps, finished jobs' wait/service samples, and the live
+    snapshot dict (mutated in place — run_wave's dispatches carry it
+    into every heartbeat)."""
+
+    def __init__(self, n_jobs: int):
+        self.t_submit = time.perf_counter()
+        self.waits: List[float] = []
+        self.services: List[float] = []
+        self.snapshot: Dict = {"queue_depth": n_jobs,
+                               "jobs_done": 0,
+                               "wait_hist": slo_histogram([]),
+                               "service_hist": slo_histogram([])}
+
+    def job_entered(self, run: "_JobRun"):
+        run.wait_s = run._t0 - self.t_submit
+
+    def job_done(self, wait_s: float, service_s: float):
+        self.waits.append(max(0.0, float(wait_s)))
+        self.services.append(max(0.0, float(service_s)))
+        self.snapshot["jobs_done"] = len(self.services)
+        self.snapshot["wait_hist"] = slo_histogram(self.waits)
+        self.snapshot["service_hist"] = slo_histogram(self.services)
+
+    def set_queue_depth(self, n: int):
+        self.snapshot["queue_depth"] = max(0, int(n))
+
+
+# ---------------------------------------------------------------------------
 # the bucket engine
 # ---------------------------------------------------------------------------
 
@@ -331,7 +388,8 @@ class BucketEngine:
     so the solo executables are never traced or compiled here."""
 
     def __init__(self, cfg, chunk: int = 128, vcap: int = 1 << 15,
-                 burst_levels: int = 8, delta_matmul: bool = True):
+                 burst_levels: int = 8, delta_matmul: bool = True,
+                 exec_cache=None):
         from ..engine.bfs import Engine
         # dedup_kernel="off": the Pallas probe kernel has no batching
         # rule; the lax claim walk is bit-identical in every mode
@@ -350,6 +408,55 @@ class BucketEngine:
         self.VCAP = self.eng.VCAP
         self._fn = self.eng.burst_batched_fn()
         self._compiled = {}            # padded J -> AOT executable
+        # constant-padding ceilings (round 13): with a serve_runtime
+        # hook, every job's guard thresholds / family lane mask /
+        # search-bounds vector enter the batched program as per-job
+        # device data (jst["rt"]) — cfg here is the bucket's CEILING,
+        # which may sit strictly above any member job's config
+        self.rt_mode = self.eng.ir.serve_runtime is not None
+        self._rt_cache: Dict[str, Dict] = {}
+        # persistent AOT executable cache (serve/exec_cache): None =
+        # the historical always-compile behavior
+        self.exec_cache = exec_cache
+
+    def _rt_of(self, cfg) -> Dict[str, np.ndarray]:
+        """One job's runtime-thresholds arrays under this bucket's
+        ceiling expander, memoized per config repr (every wave of a
+        resumed/parked job re-enters with identical arrays)."""
+        key = repr(cfg)
+        rt = self._rt_cache.get(key)
+        if rt is None:
+            rt = self._rt_cache[key] = \
+                self.eng.ir.serve_runtime(self.eng.expander, cfg)
+        return rt
+
+    def _exec_key_parts(self, JP: int) -> Dict:
+        """Every compile-relevant identity of the (bucket, JP)
+        executable — serve/exec_cache docstring.  The ceiling cfg repr
+        covers the predicate name lists, symmetry and fp128; the
+        engine fields cover the program's static shapes and modes."""
+        from .exec_cache import backend_fingerprint, code_fingerprint
+        eng = self.eng
+        return {
+            "backend": backend_fingerprint(),
+            # source identity: any package code change is a miss (a
+            # stale executable must never answer for new semantics)
+            "code": code_fingerprint(),
+            "spec": eng.ir.name,
+            "ir_fingerprint": eng.ir.fingerprint(),
+            "ceiling_cfg": repr(eng.cfg),
+            "JP": JP,
+            "chunk": eng.chunk, "KB": self.KB, "VCAP": self.VCAP,
+            "FCAP": eng.FCAP, "OCAP": eng.OCAP,
+            "burst_levels": eng.burst_levels,
+            "fam_caps": list(eng.FAM_CAPS),
+            "W": eng.W,
+            "guard_matmul": eng.guard_matmul,
+            "delta_matmul": eng.expander.delta_active,
+            "incremental_fp": bool(eng.incremental_fp and
+                                   eng.fpr.supports_incremental()),
+            "rt_mode": self.rt_mode,
+        }
 
     # -- root admission ------------------------------------------------
 
@@ -371,8 +478,15 @@ class BucketEngine:
             return None
         narrow_mj = {k: np.asarray(v) for k, v in
                      eng.ir.narrow(eng.lay, eng.ir.widen(roots)).items()}
-        inv_r, con_r = eng._phase2(
-            {k: jnp.asarray(v) for k, v in roots.items()})
+        rootsj = {k: jnp.asarray(v) for k, v in roots.items()}
+        if self.rt_mode:
+            # root constraints gate level-0 expansion: they must read
+            # the JOB's bounds, not the ceiling's
+            inv_r, con_r = eng._phase2_rt(
+                rootsj,
+                jnp.asarray(self._rt_of(run.job.cfg)["bounds"]))
+        else:
+            inv_r, con_r = eng._phase2(rootsj)
         inv_r, con_r = np.asarray(inv_r), np.asarray(con_r)
         res = run.res
         res.distinct_states = n
@@ -421,7 +535,13 @@ class BucketEngine:
               for k, v in one.items()}
         fm = np.zeros((self.KB,), bool)
         vis = np.full((eng.W, self.VCAP), U32MAX_NP, np.uint32)
-        return dict(fr=fr, fm=fm, vis=vis, nf=0, g=0)
+        out = dict(fr=fr, fm=fm, vis=vis, nf=0, g=0)
+        if self.rt_mode:
+            # a pad job still needs rt arrays of the stacked shape;
+            # the ceiling's own (all-enabled) data is the natural
+            # no-op — the pad lane is frozen (nf=0) regardless
+            out["rt"] = self._rt_of(eng.cfg)
+        return out
 
     def _stack(self, inits):
         import jax.numpy as jnp
@@ -431,7 +551,16 @@ class BucketEngine:
         # ring prefix; no previous level); a restored/parked init
         # carries its real cursors (wave-state resume, round 12)
         gd0 = np.arange(self.KB, dtype=np.int32)
+        rt = {}
+        if self.rt_mode:
+            # per-job runtime thresholds / lane masks / bounds on the
+            # leading [J] axis (engine/bfs._batched_burst_impl)
+            rt = dict(rt={
+                nm: jnp.asarray(np.stack(
+                    [np.asarray(it["rt"][nm]) for it in inits]))
+                for nm in ("thr", "mask", "bounds")})
         return dict(
+            **rt,
             vis=tuple(jnp.asarray(np.stack([it["vis"][w]
                                             for it in inits]))
                       for w in range(eng.W)),
@@ -471,7 +600,8 @@ class BucketEngine:
                  jobs_ctx: Optional[Dict] = None,
                  verbose: bool = False,
                  max_steps: Optional[int] = None,
-                 wave_state: Optional[WaveStateStore] = None):
+                 wave_state: Optional[WaveStateStore] = None,
+                 slo_ctx: Optional[Dict] = None):
         """Run up to a wave of jobs through the batched burst.
         Mutates the runs in place; jobs that bail are marked for the
         sequential fallback.  ``jobs_ctx`` is the batch-global per-job
@@ -498,6 +628,11 @@ class BucketEngine:
                 else:
                     init = self._admit(run)
                 if init is not None:
+                    if self.rt_mode:
+                        # rt is derived from the job's config, never
+                        # persisted: parked/restored carries re-attach
+                        # it here (bit-identical arrays by construction)
+                        init["rt"] = self._rt_of(run.job.cfg)
                     admitted.append((run, init))
         if not any(run.live for run, _ in admitted):
             for run, _ in admitted:
@@ -525,12 +660,30 @@ class BucketEngine:
                         2 ** 31 - 1))
             lvj, capj = jnp.asarray(lv), jnp.asarray(cap)
             ex = self._compiled.get(JP)
+            key = parts = None
+            if ex is None and self.exec_cache is not None:
+                # persistent AOT executable cache (serve/exec_cache):
+                # a warm restart loads the serialized executable and
+                # performs ZERO .compile() calls; any failure is a
+                # labeled miss and falls through to the compile below
+                from .exec_cache import exec_key
+                parts = self._exec_key_parts(JP)
+                key = exec_key(parts)
+                with obs.span("bucket_exec_load"):
+                    ex, _why = self.exec_cache.load(key, parts)
+                if ex is not None:
+                    self._compiled[JP] = ex
             if ex is None:
                 # AOT compile, in its own span: the bench and the
                 # ledger attribute bucket-compile seconds exactly
                 with obs.span("bucket_compile"):
                     ex = self._fn.lower(jst, lvj, capj).compile()
                 self._compiled[JP] = ex
+                if self.exec_cache is not None:
+                    # store failures are counted + named (a backend
+                    # without serialization support), never raised
+                    with obs.span("bucket_exec_store"):
+                        self.exec_cache.store(key, ex, parts)
             with obs.span("batched_dispatch"):
                 jst, out = ex(jst, lvj, capj)
                 stats = np.asarray(out["stats"])   # the ONE sync
@@ -593,7 +746,7 @@ class BucketEngine:
                     "generated_states": sum(
                         int(r.res.generated_states)
                         for r in live_runs)},
-                jobs=jobs_map)
+                jobs=jobs_map, slo=slo_ctx)
             if verbose:
                 done = sum(1 for r in live_runs if not r.live)
                 print(f"batch wave: {done}/{len(live_runs)} jobs done, "
@@ -699,7 +852,8 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
              sequential: bool = False, bucket_overrides=None,
              verbose: bool = False, wave_state=None,
              wave_yield: Optional[int] = None,
-             max_wave: Optional[int] = None) -> BatchReport:
+             max_wave: Optional[int] = None,
+             exec_cache=None) -> BatchReport:
     """Serve a job list: cache lookups, shape-bucket grouping, batched
     waves, sequential fallbacks, cache fill.  Returns a BatchReport
     with outcomes in submission order.
@@ -708,6 +862,13 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
     per job) — the honest A/B reference bench.py records.
     bucket_overrides overrides the per-spec bucket params (tests force
     tiny rings with it to exercise the fallback).
+
+    exec_cache (round 13) — a serve/exec_cache.ExecCache or a
+    directory path: bucket executables are serialized around their
+    ``.lower().compile()`` so a process restart re-loads them instead
+    of re-paying the 30-50 s TPU compiles; hit/miss/store counters
+    (incl. named miss reasons on backends that cannot serialize) land
+    in the batch meta, the ledger and the heartbeat SLO snapshot.
 
     Round 12 (preemptible waves): jobs schedule by descending
     ``Job.priority`` (stable on submission order); ``wave_yield=N``
@@ -723,6 +884,9 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
     t0 = time.perf_counter()
     if isinstance(wave_state, str):
         wave_state = WaveStateStore(wave_state)
+    if isinstance(exec_cache, str):
+        from .exec_cache import ExecCache
+        exec_cache = ExecCache(exec_cache)
     if wave_yield is not None and int(wave_yield) < 1:
         raise ValueError(f"wave_yield must be >= 1 "
                          f"(got {wave_yield})")
@@ -733,6 +897,7 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
                 engines_compiled=0, batch_dispatches=0,
                 fallback_jobs=0, sequential=bool(sequential),
                 resumed_jobs=0, parked_waves=0)
+    slo = _SloTracker(len(jobs))
     # labels key the heartbeat/watch job map and the report rows —
     # empty ones get positional names, duplicates get #N suffixes so
     # two same-labeled jobs never collapse into one watch line.  (The
@@ -766,6 +931,7 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
                 "depth": int(hit.get("depth", 0)),
                 "distinct": int(hit.get("distinct_states", 0)),
                 "status": "cache_hit"}
+            slo.job_done(0.0, 0.0)     # served instantly, honestly
             _job_row(obs, outcomes[i])
         elif key in key_first:
             # two equal cache keys in one list are guaranteed the
@@ -818,7 +984,7 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
         meta["buckets"] = len(buckets)
         for bkey, (ceiling, params, idxs) in buckets.items():
             from collections import deque
-            be = BucketEngine(ceiling, **params)
+            be = BucketEngine(ceiling, exec_cache=exec_cache, **params)
             meta["engines_compiled"] += 1
             # wave scheduling: priority first (stable on submission
             # order), parked jobs requeue at the back — a long job
@@ -831,15 +997,26 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
                         for _ in range(min(wave_cap, len(queue)))]
                 runs = []
                 for i in wave:
-                    run = parked_runs.pop(i, None) or \
-                        restored.pop(i, None) or _JobRun(jobs[i])
+                    run = parked_runs.pop(i, None)
+                    if run is None:
+                        # fresh AND wave-state-restored jobs stamp
+                        # their wait here (a restored run's _t0 is its
+                        # restore time in THIS process — its pre-kill
+                        # runtime is not recoverable, which the
+                        # row's "resumed from wave state" status_reason
+                        # flags for SLO consumers); parked runs keep
+                        # the wait stamped at their first entry
+                        run = restored.pop(i, None) or _JobRun(jobs[i])
+                        slo.job_entered(run)
                     run.parked = False
                     runs.append(run)
+                answered = sum(1 for o in outcomes if o is not None)
+                slo.set_queue_depth(len(jobs) - answered - len(runs))
                 be.run_wave(
                     runs, obs, meta, jobs_ctx=jobs_ctx,
                     verbose=verbose,
                     max_steps=wave_yield if queue else None,
-                    wave_state=wave_state)
+                    wave_state=wave_state, slo_ctx=slo.snapshot)
                 if any(run.parked for run in runs):
                     # one increment per wave that yielded, however
                     # many jobs parked in it (the key counts WAVES)
@@ -869,12 +1046,20 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
                                                    "done",
                                                    reason=reason,
                                                    tracer=tracer)
+                    outcome.report["wait_s"] = round(run.wait_s, 3)
+                    outcome.report["service_s"] = round(
+                        run.res.seconds, 3)
+                    slo.job_done(run.wait_s, run.res.seconds)
                     outcomes[i] = outcome
     meta["fallback_jobs"] = sum(1 for _i, st, _r in solo
                                 if st == "fallback")
     for i, status, reason in solo:
+        wait_s = time.perf_counter() - slo.t_submit
         outcomes[i] = _run_solo(jobs[i], obs, meta, status, reason)
         res = outcomes[i].res
+        outcomes[i].report["wait_s"] = round(wait_s, 3)
+        outcomes[i].report["service_s"] = round(res.seconds, 3)
+        slo.job_done(wait_s, res.seconds)
         jobs_ctx[jobs[i].label] = {"depth": int(res.depth),
                                    "distinct":
                                    int(res.distinct_states),
@@ -888,11 +1073,47 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
             "depth": int(payload.get("depth", 0)),
             "distinct": int(payload.get("distinct_states", 0)),
             "status": "cache_hit"}
+        slo.job_done(0.0, 0.0)
         _job_row(obs, outcomes[i])
+    slo.set_queue_depth(0)
+    if exec_cache is not None:
+        # honest executable-cache accounting into the summary, the
+        # heartbeat SLO snapshot and (below) the ledger
+        stats = exec_cache.stats()
+        meta.update(stats)
+        slo.snapshot["exec_cache"] = {
+            k: v for k, v in stats.items()
+            if not k.endswith("_reasons")}
     if jobs_ctx:
-        # the final heartbeat carries the whole batch's job map, incl.
-        # cache hits and solo jobs that never rode a batched dispatch
-        obs.set_jobs(jobs_ctx)
+        # the final heartbeat carries the whole batch's job map + SLO
+        # snapshot, incl. cache hits and solo jobs that never rode a
+        # batched dispatch
+        obs.set_jobs(jobs_ctx, slo=slo.snapshot)
+    if obs.ledger is not None:
+        # per-tenant (spec) rollups: one kind="tenant" record per spec
+        # in the batch — the multi-tenant SLO summary a dashboard
+        # (tools/watch.py --ledger) reads without parsing job rows
+        tenants: Dict[str, Dict] = {}
+        for o in outcomes:
+            t = tenants.setdefault(o.job.ir.name, dict(
+                kind="tenant", spec=o.job.ir.name, jobs=0,
+                cache_hits=0, fallbacks=0, violations=0,
+                distinct_states=0, wait_s=0.0, service_s=0.0))
+            t["jobs"] += 1
+            t["cache_hits"] += int(o.status == "cache_hit")
+            t["fallbacks"] += int(o.status == "fallback")
+            t["violations"] += int(o.report.get("violations", 0))
+            t["distinct_states"] += int(
+                o.report.get("distinct_states", 0))
+            t["wait_s"] += float(o.report.get("wait_s", 0.0))
+            t["service_s"] += float(o.report.get("service_s", 0.0))
+        for t in tenants.values():
+            t["wait_s"] = round(t["wait_s"], 3)
+            t["service_s"] = round(t["service_s"], 3)
+            obs.ledger.record(t)
+        if exec_cache is not None:
+            obs.ledger.record({"kind": "exec_cache",
+                               **exec_cache.stats()})
     for outcome in outcomes:
         if outcome.status == "cache_hit":
             continue

@@ -113,10 +113,24 @@ class SpecIR:
     # serving-layer bucket ceiling (serve/batch): cfg -> (ceiling cfg,
     # bucket param dict).  Jobs whose ceiling cfg + params match batch
     # into ONE job-vmapped device program; the ceiling is the config
-    # the bucket engine compiles (== cfg until a spec can pad value
-    # bounds up, which needs runtime guard thresholds — ROADMAP 2b),
-    # and the params size the per-job rings for small serving jobs.
+    # the bucket engine compiles.  Round 13: the ceiling may now be
+    # STRICTLY ABOVE the job's config — value-like bounds (MaxTerm
+    # etc., paxos ballots/values/instances) pad up to a rung ladder
+    # (``pad_rung``) so heterogeneous small configs share one
+    # AOT-compiled program — provided the spec also supplies
+    # ``serve_runtime`` below to restore the job's exact semantics.
     serve_bucket: Optional[Callable] = None
+    # (expander, job cfg) -> the job's runtime-thresholds data under
+    # the bucket's CEILING expander: {"thr": int32 [A] guard
+    # thresholds, "mask": bool [A] family lane mask, "bounds": int32
+    # [NB] search-bounds vector} (host numpy; serve/batch stacks a
+    # leading [J] axis and the batched burst vmaps them as device
+    # data).  The contract that makes a padded ceiling EXACT: masked
+    # lanes never generate candidates, so the surviving stream is the
+    # job's own enumeration order, and every Bounded*-style constraint
+    # reads the job's own bound from the vector.  None = ceilings are
+    # always exact for this spec (the pre-round-13 contract).
+    serve_runtime: Optional[Callable] = None
 
     @property
     def all_keys(self) -> Tuple[str, ...]:
@@ -138,6 +152,24 @@ class SpecIR:
             list(self.view_keys), list(self.nonview_keys),
         ], separators=(",", ":"))
         return hashlib.sha256(desc.encode()).hexdigest()[:12]
+
+
+def pad_rung(v: int, floor: int = 1) -> int:
+    """The serving ceiling ladder: round a value-like bound up to the
+    next power of two, never below ``floor``.  Shared by every spec's
+    ``serve_bucket`` so two tenants' independently-computed ceilings
+    agree whenever their bounds share a rung — that agreement IS the
+    bucket hit.  Coarser rungs (a higher floor) = more sharing but
+    bigger padded layouts; powers of two keep the worst-case pad at
+    2x above the floor.  Each spec picks its floor by what padding
+    costs it: raft bounds only widen bit-packing fields (floor 4 —
+    the whole small-serving range shares one rung), while paxos
+    ballots/values/instances multiply the message universe and the
+    lane grid (floor 2)."""
+    v = max(int(v), int(floor))
+    if v <= 1:
+        return max(v, 0)
+    return 1 << (v - 1).bit_length()
 
 
 # ---------------------------------------------------------------------------

@@ -139,14 +139,61 @@ def sim_progress(kern, lay):
 
 
 def serve_bucket(cfg):
-    """Bucket ceiling for the batched serving layer (serve/batch): the
-    same exact-ceiling v1 contract as the raft hook — ballots/values/
-    acceptors/instances all shape the packed message universe and the
-    quorum closed forms, so jobs batch on an identical config and
-    differ in depth/state gates and option sets.  Paxos states are
-    tiny (a u32 msgs bitmask + [I, N] acceptor arrays), so the default
-    small-job ring (4 * chunk rows, 2^15-slot table) is generous."""
-    return cfg, dict(chunk=128, vcap=1 << 15, burst_levels=8)
+    """Bucket ceiling for the batched serving layer (serve/batch).
+
+    Round 13 — constant-padding ceilings: ballots, values and
+    instances pad up to the shared rung ladder (``spec.pad_rung``), so
+    heterogeneous matched-constants sweeps (the *Paxos vs Raft*
+    arXiv:2004.05074 workload) share ONE compiled program per ceiling.
+    The padded message universe and [I, N] arrays compile at the
+    ceiling's widths; each job's own bounds become its family LANE
+    MASK (``serve_runtime`` below) — a padded ballot/value/instance
+    lane is masked off before compaction, so no message with an
+    out-of-bounds constant is ever sent, every quorum/choice closed
+    form sees exactly the job's own message set, and padded instances
+    sit frozen at their init cells.  Acceptor count stays exact: it is
+    structural (quorum enumeration, the symmetry group).
+
+    Paxos states are tiny (a u32 msgs bitmask + [I, N] acceptor
+    arrays), so the default small-job ring (4 * chunk rows, 2^15-slot
+    table) is generous."""
+    from .. import pad_rung
+    # floor 2: paxos padding multiplies the message universe (the 1b
+    # block is ~B^2*V per acceptor), so the ladder stays tight —
+    # 1->2->4->8; instances floor 1 (a padded instance is pure dead
+    # weight in every state row)
+    ceiling = cfg.with_(n_ballots=pad_rung(cfg.n_ballots, floor=2),
+                        n_values=pad_rung(cfg.n_values, floor=2),
+                        n_instances=pad_rung(cfg.n_instances))
+    return ceiling, dict(chunk=128, vcap=1 << 15, burst_levels=8)
+
+
+def serve_runtime(expander, cfg):
+    """The job's runtime-thresholds data under the bucket's ceiling
+    expander (SpecIR.serve_runtime contract).  Thresholds are the
+    ceiling's (paxos guards are single-feature, threshold 1); the lane
+    mask is where the job's bounds live: each family's (instance,
+    ballot[, value]) lane params must fall inside the job's own
+    n_instances/n_ballots/n_values.  The acceptor param (Phase1b/2b's
+    ``a``) is never masked — acceptors are structural."""
+    import numpy as np
+    I, B, V = cfg.n_instances, cfg.n_ballots, cfg.n_values
+    thr, mask = expander.runtime_thresholds()
+    in_bounds = {
+        "Phase1a": lambda i, b: i < I and b < B,
+        "Phase1b": lambda i, a, b: i < I and b < B,
+        "Phase2a": lambda i, b, v: i < I and b < B and v < V,
+        "Phase2b": lambda i, a, b, v: i < I and b < B and v < V,
+    }
+    lane = 0
+    for fam in expander.families:
+        ok = in_bounds[fam.name]
+        for vals in zip(*fam.params) if fam.params else [()]:
+            mask[lane] = ok(*(int(v) for v in vals))
+            lane += 1
+    assert lane == expander.n_lanes
+    return dict(thr=thr, mask=mask,
+                bounds=np.zeros((0,), np.int32))
 
 
 def build_ir() -> SpecIR:
@@ -195,4 +242,5 @@ def build_ir() -> SpecIR:
         sim_progress=sim_progress,
         default_config=PaxosConfig,
         serve_bucket=serve_bucket,
+        serve_runtime=serve_runtime,
     )

@@ -29,7 +29,7 @@ from typing import List
 
 import numpy as np
 
-from ..config import (NEXT_ASYNC_CRASH, NEXT_DYNAMIC, NEXT_FULL)
+from ..config import (NEXT_ASYNC_CRASH, NEXT_DYNAMIC, NEXT_FULL, Bounds)
 from . import SpecIR
 
 
@@ -263,25 +263,98 @@ def sim_progress(kern, lay):
     return score
 
 
+# Which search bound each Bounded* constraint guards: a bound may pad
+# up to the serving ceiling ONLY while its constraint is active —
+# without the constraint the bound is load-bearing in the kernels'
+# representability clamps (ops/kernels.term_cap), and padding it would
+# change the reachable set.  An inactive constraint keeps the bound
+# exact in the ceiling, so such jobs simply bucket by exact value.
+_BOUND_CONSTRAINTS = {
+    "max_log_length": "BoundedLogSize",
+    "max_restarts": "BoundedRestarts",
+    "max_timeouts": "BoundedTimeouts",
+    "max_terms": "BoundedTerms",
+    "max_client_requests": "BoundedClientRequests",
+    "max_tried_membership_changes": "BoundedTriedMembershipChanges",
+    "max_membership_changes": "BoundedMembershipChanges",
+}
+
+
 def serve_bucket(cfg):
     """Bucket ceiling for the batched serving layer (serve/batch).
 
-    Jobs whose compiled operator surface is identical batch into one
-    job-vmapped device program.  Every Raft constant is shape- or
-    guard-bearing (constants compile into the packed layout and the
-    int8 guard matrix, bounds into the constraint predicates), so the
-    v1 ceiling is exact: ceiling == cfg and the bucket key is the full
-    config repr — jobs still amortize compile/dispatch whenever many
-    tenants check the same model under different depth/state gates or
-    option sets.  Padding value-like bounds (MaxTerm etc.) up to a
-    shared ceiling needs per-job guard thresholds threaded through the
-    expander; that remaining half is recorded in ROADMAP 2b.
+    Round 13 — constant-padding ceilings: every constraint-guarded
+    search bound pads up to the shared rung ladder (``spec.pad_rung``)
+    so heterogeneous tenants (differing MaxTerm/MaxTimeouts/... under
+    the stock constraint set) land in ONE bucket and share one
+    AOT-compiled program.  The int8 guard matrix, the delta matrices
+    and the packed layout compile at the CEILING's widths; each job's
+    own bounds ride the runtime-bounds vector (``serve_runtime``
+    below) into the constraint predicates, and the witness-bearing
+    clamps (terms, log room) stay at the ceiling's representability
+    width — exact, because a constraint-pruned state is never expanded
+    in either layout, so an in-bounds job can never reach a clamp.
+
+    Bounds WITHOUT their guarding constraint stay exact in the ceiling
+    (see _BOUND_CONSTRAINTS); structural constants (servers, values,
+    NEXT family, rounds, the predicate name lists, symmetry/fp128)
+    always key the bucket exactly — padding those would change the
+    compiled operator surface itself.  max_trace stays exact too: it
+    backs the BoundedTrace *scenario invariant*, whose verdict is part
+    of the job's answer.
 
     The params size the per-job rings for small serving jobs: ring =
     4 * chunk frontier rows per job, a 2^15-slot visited table
     (~13k keys at the 0.40 load bound).  A job outgrowing either bails
     to the sequential fallback."""
-    return cfg, dict(chunk=128, vcap=1 << 15, burst_levels=8)
+    from . import pad_rung
+    b = cfg.bounds
+    cons = set(cfg.constraints)
+
+    def pad(name):
+        # floor 4: every bound in the small-serving range rounds onto
+        # ONE rung (raft bound padding only widens bit-packed fields,
+        # so a generous floor is near-free and maximizes bucket hits)
+        v = getattr(b, name)
+        return pad_rung(v, floor=4) if _BOUND_CONSTRAINTS[name] in cons \
+            else v
+
+    ceiling_bounds = Bounds(
+        max_log_length=pad("max_log_length"),
+        max_restarts=pad("max_restarts"),
+        max_timeouts=pad("max_timeouts"),
+        max_client_requests=pad("max_client_requests"),
+        max_membership_changes=pad("max_membership_changes"),
+        max_terms=pad("max_terms"),
+        max_tried_membership_changes=pad(
+            "max_tried_membership_changes"),
+        max_trace=b.max_trace)
+    kw = {}
+    if ceiling_bounds != b:
+        kw["bounds"] = ceiling_bounds
+    if cfg.max_inflight_override is not None and \
+            "BoundedInFlightMessages" in cons:
+        # the override is a real bound (shape-bearing via bag slots):
+        # pad it like the rest; the derived 2*S^2 default is a formula
+        # over the structural |Server| and stays as-is
+        padded = pad_rung(cfg.max_inflight_override)
+        if padded != cfg.max_inflight_override:
+            kw["max_inflight_override"] = padded
+    ceiling = cfg.with_(**kw) if kw else cfg
+    return ceiling, dict(chunk=128, vcap=1 << 15, burst_levels=8)
+
+
+def serve_runtime(expander, cfg):
+    """The job's runtime-thresholds data under the bucket's ceiling
+    expander (SpecIR.serve_runtime contract): ceiling guard thresholds
+    as device data, an all-enabled lane mask (raft lane grids are
+    structural — servers/values/bag slots — and bag-slot lanes must
+    stay enabled even under a padded MaxInFlight, since occupancy of
+    the first K_job slots drives them), and the job's own search
+    bounds for the constraint predicates."""
+    from ..ops.vpredicates import runtime_bounds
+    thr, mask = expander.runtime_thresholds()
+    return dict(thr=thr, mask=mask, bounds=runtime_bounds(cfg))
 
 
 # ---------------------------------------------------------------------------
@@ -335,4 +408,5 @@ def build_ir() -> SpecIR:
         sim_progress=sim_progress,
         default_config=None,
         serve_bucket=serve_bucket,
+        serve_runtime=serve_runtime,
     )

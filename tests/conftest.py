@@ -22,6 +22,28 @@ jax.config.update("jax_platforms", "cpu")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# ---------------------------------------------------------------------------
+# Shared oracle-reference cache (round-13 suite diet): many files
+# compare engines against the SAME (cfg, depth) oracle exploration —
+# each Python BFS re-run costs seconds against the 870s tier-1 budget.
+# Results are treated as READ-ONLY by every caller (counts /
+# level_sizes / violations / kept states are only read).
+# ---------------------------------------------------------------------------
+
+_ORACLE_CACHE = {}
+
+
+def cached_explore(cfg, **kw):
+    """spec_of(cfg).oracle_explore(cfg, **kw), memoized per (spec,
+    cfg repr, kwargs) for the whole session."""
+    from raft_tla_tpu.spec import spec_of
+    ir = spec_of(cfg)
+    key = (ir.name, repr(cfg), tuple(sorted(kw.items())))
+    if key not in _ORACLE_CACHE:
+        _ORACLE_CACHE[key] = ir.oracle_explore(cfg, **kw)
+    return _ORACLE_CACHE[key]
+
+
 def ref_or_local(path: str) -> str:
     """A reference model path (/root/reference/...), falling back to
     the repo-local twin under configs/ when the reference tree is not

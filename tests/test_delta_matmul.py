@@ -57,17 +57,18 @@ def _key(r):
 
 
 def _oracle_key(cfg, max_depth=10 ** 9):
-    ir = get_spec(getattr(cfg, "spec", "raft"))
-    w = ir.oracle_explore(cfg, max_depth=max_depth)
+    from conftest import cached_explore
+    w = cached_explore(cfg, max_depth=max_depth)
     return (w.distinct_states, w.generated_states, w.depth,
             tuple(w.level_sizes), len(w.violations))
 
 
 def _reachable_svT(cfg, n=120):
     """A batch of reachable states, batch-last, via the oracle."""
+    from conftest import cached_explore
     ir = get_spec(getattr(cfg, "spec", "raft"))
     lay = ir.make_layout(cfg)
-    r = ir.oracle_explore(cfg, max_states=3 * n, keep_states=True)
+    r = cached_explore(cfg, max_states=3 * n, keep_states=True)
     pairs = list(r.states.values())[:n]
     rows = [ir.encode(lay, sv, h) for sv, h in pairs]
     batch = ir.widen({k: np.stack([s[k] for s in rows])

@@ -10,6 +10,22 @@ dispatch records of any kind (kind=batch/burst/level) — only the
 kind=job completion rows.  Exercises: JSONL parsing, bucketing, the
 job-vmapped burst, report assembly, ResultCache round-trip, and the
 obs threading (ledger + heartbeat incl. the per-job map).
+
+Round 13 adds two steps:
+
+- **heterogeneous-constants wave** — K=4 raft jobs with DISTINCT
+  value bounds (max_timeouts × max_log_length) land in ONE padded
+  bucket ceiling and compile ONCE: the summary reports buckets=1 /
+  engines_compiled=1 and the span timeline holds exactly one
+  ``bucket_compile`` event (bit-exactness vs solo engines is pinned
+  by tests/test_serve.py; this smoke pins the CLI-level
+  compile-amortization contract every run);
+- **executable-cache warm rerun** — the same wave re-runs with a
+  fresh result cache but a warm ``--executable-cache``: zero
+  ``bucket_compile`` spans, every executable loaded from disk.  On a
+  backend whose runtime cannot serialize executables the step SKIPS
+  with the named store-failure reason (the honest-miss contract) —
+  never a crash.
 """
 
 import json
@@ -33,18 +49,24 @@ INVARIANT Agreement
 """
 
 
-def run_batch(jobs_path, cache_dir, ledger, heartbeat):
+def run_batch(jobs_path, cache_dir, ledger, heartbeat, extra=()):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.run(
         [sys.executable, "-m", "raft_tla_tpu", "batch",
          "--jobs", jobs_path, "--cache-dir", cache_dir,
-         "--ledger", ledger, "--heartbeat", heartbeat],
+         "--ledger", ledger, "--heartbeat", heartbeat, *extra],
         capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
     assert p.returncode == 0, (p.returncode, p.stdout, p.stderr)
     lines = [json.loads(ln) for ln in p.stdout.splitlines() if ln]
     summary, rows = lines[0], lines[1:]
     assert summary["kind"] == "batch_summary", summary
     return summary, rows
+
+
+def span_count(timeline_path, name):
+    """Occurrences of a span name in a Chrome-trace timeline file."""
+    with open(timeline_path) as fh:
+        return fh.read().count(f'"name": "{name}"')
 
 
 def ledger_kinds(path):
@@ -103,10 +125,68 @@ def main():
         assert a["distinct_states"] == b["distinct_states"] and \
             a["level_sizes"] == b["level_sizes"], (a, b)
     k2 = ledger_kinds(os.path.join(tmp, "l2"))
-    assert set(k2) == {"job"}, \
+    assert set(k2) - {"tenant"} == {"job"}, \
         f"cached re-run must dispatch nothing, ledger kinds: {k2}"
     print("serve_smoke: OK (2 jobs batched; re-run 100% cache, "
           "0 device dispatches)")
+
+    # step 3: heterogeneous-constants wave — 4 raft jobs with distinct
+    # bounds share ONE padded bucket ceiling and compile ONCE
+    het = []
+    for k, (mt, mll) in enumerate(((1, 1), (2, 1), (1, 2), (2, 2))):
+        het.append({
+            "spec": "raft",
+            "config": "configs/tlc_membership/raft.cfg",
+            "overrides": {"servers": 2, "values": [1],
+                          "max_inflight": 4, "next": "NextAsync",
+                          "bounds": {"max_log_length": mll,
+                                     "max_timeouts": mt,
+                                     "max_client_requests": 1}},
+            "max_depth": 4, "label": f"het{k}"})
+    het_path = os.path.join(tmp, "het.jsonl")
+    with open(het_path, "w") as fh:
+        for obj in het:
+            fh.write(json.dumps(obj) + "\n")
+    tl3 = os.path.join(tmp, "tl3.json")
+    exec_dir = os.path.join(tmp, "exec")
+    s3, rows3 = run_batch(
+        het_path, os.path.join(tmp, "cache3"),
+        os.path.join(tmp, "l3"), hb,
+        extra=("--trace-timeline", tl3,
+               "--executable-cache", exec_dir))
+    assert s3["buckets"] == 1 and s3["engines_compiled"] == 1, s3
+    assert s3["fallback_jobs"] == 0, s3
+    assert all(r["status"] == "done" for r in rows3), rows3
+    ncomp = span_count(tl3, "bucket_compile")
+    assert ncomp == 1, \
+        f"heterogeneous wave must compile ONCE, saw {ncomp} spans"
+    with open(hb) as fh:
+        hb3 = json.load(fh)
+    assert "slo" in hb3 and "service_hist" in hb3["slo"], hb3
+
+    # step 4: executable-cache warm rerun — fresh RESULT cache (so the
+    # wave really re-runs) but a warm exec cache: zero compiles
+    if s3.get("exec_cache_store_failures"):
+        why = (s3.get("exec_cache_store_fail_reasons") or ["?"])[-1]
+        print(f"serve_smoke: heterogeneous wave OK (1 bucket_compile "
+              f"span); SKIPPING warm-rerun step — backend cannot "
+              f"serialize executables: {why}")
+        return
+    tl4 = os.path.join(tmp, "tl4.json")
+    s4, rows4 = run_batch(
+        het_path, os.path.join(tmp, "cache4"),
+        os.path.join(tmp, "l4"), hb,
+        extra=("--trace-timeline", tl4,
+               "--executable-cache", exec_dir))
+    assert s4.get("exec_cache_hits", 0) >= 1, s4
+    ncomp4 = span_count(tl4, "bucket_compile")
+    assert ncomp4 == 0, \
+        f"warm exec-cache rerun must compile NOTHING, saw {ncomp4}"
+    for a, b in zip(rows3, rows4):
+        assert a["distinct_states"] == b["distinct_states"] and \
+            a["level_sizes"] == b["level_sizes"], (a, b)
+    print("serve_smoke: OK (heterogeneous wave: 4 jobs, 1 compile; "
+          "warm exec-cache rerun: 0 compiles)")
 
 
 if __name__ == "__main__":

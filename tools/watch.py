@@ -21,6 +21,15 @@ carries a per-job status map; one extra line renders per job:
   job raft-micro: depth 4  29 states  done
   job paxos-micro: depth 3  44 states  running
 
+A batch heartbeat also carries the SLO snapshot (round 13): queue
+depth, per-job wait/service-seconds histograms and the executable-
+cache counters render as dashboard lines after the job map:
+
+  queue: 3 waiting, 5 done
+  wait:    <=0.25s:4 <=1s:1
+  service: <=1s:3 <=5s:2
+  exec-cache: 2 hits, 1 misses, 1 stored
+
 Usage:
   python tools/watch.py HEARTBEAT [--ledger FILE] [--interval SEC]
                         [--stale SEC] [--once]
@@ -77,6 +86,49 @@ def job_lines(hb):
     return out
 
 
+def _hist_summary(hist):
+    """'<=0.25s:3 <=1s:2 >120s:1' — only the occupied buckets, in
+    edge order (the heartbeat keeps the full fixed-bucket histogram;
+    'inf' is the catch-all above the largest edge)."""
+    out = []
+    last_edge = "?"
+    for k, v in (hist or {}).items():
+        if k.startswith("le_"):
+            last_edge = k[3:]
+            if v:
+                out.append(f"<={last_edge}s:{v}")
+        elif k == "inf" and v:
+            out.append(f">{last_edge}s:{v}")
+    return " ".join(out)
+
+
+def slo_lines(hb):
+    """The serving layer's SLO snapshot (queue depth, wait/service
+    histograms, exec-cache counters) as rendered dashboard lines; []
+    when the heartbeat carries none."""
+    slo = hb.get("slo")
+    if not slo:
+        return []
+    out = [f"  queue: {int(slo.get('queue_depth', 0))} waiting, "
+           f"{int(slo.get('jobs_done', 0))} done"]
+    w = _hist_summary(slo.get("wait_hist"))
+    s = _hist_summary(slo.get("service_hist"))
+    if w:
+        out.append(f"  wait:    {w}")
+    if s:
+        out.append(f"  service: {s}")
+    ec = slo.get("exec_cache")
+    if ec:
+        out.append(
+            f"  exec-cache: {int(ec.get('exec_cache_hits', 0))} hits, "
+            f"{int(ec.get('exec_cache_misses', 0))} misses, "
+            f"{int(ec.get('exec_cache_stores', 0))} stored"
+            + (f", {int(ec['exec_cache_store_failures'])} store "
+               f"failures (backend cannot serialize?)"
+               if ec.get("exec_cache_store_failures") else ""))
+    return out
+
+
 def status_line(hb_path, ledger_path, stale_s):
     """(line, exit_code): 0 healthy, 1 stalled/dead, 2 unreadable.
     Batch heartbeats append one line per job (job_lines)."""
@@ -130,7 +182,7 @@ def status_line(hb_path, ledger_path, stale_s):
     else:
         parts.append(f"pid {hb['pid']} alive")
     line = "  ".join(parts)
-    jl = job_lines(hb)
+    jl = job_lines(hb) + slo_lines(hb)
     if jl:
         line = "\n".join([line] + jl)
     return line, code
