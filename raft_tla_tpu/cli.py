@@ -340,9 +340,13 @@ def cmd_check(args):
         print("--resume-portable qualifies --resume: pass the "
               "checkpoint with --resume FILE", file=sys.stderr)
         return 2
-    if args.resume_portable and not args.spill:
+    if args.resume_portable and not (args.spill or args.pjit):
         print("--resume-portable re-partitions any engine family's "
-              "checkpoint onto the spill engine: add --spill",
+              "checkpoint onto the spill or pjit engine: add --spill "
+              "or --pjit", file=sys.stderr)
+        return 2
+    if args.pjit and args.spill:
+        print("--pjit and --spill are different engines; pick one",
               file=sys.stderr)
         return 2
     err = _check_retry_flags(args) or _install_chaos(args)
@@ -436,8 +440,18 @@ def cmd_check(args):
                                   host_table=args.host_table,
                                   partitions=args.partitions,
                                   part_cap=args.part_cap,
+                                  sweep_stage=args.sweep_stage,
                                   archive_dir=args.archive_dir,
                                   **burst_kw)
+            elif args.pjit:
+                # pod-scale pjit engine: the classic program under
+                # named shardings spanning every host's devices
+                # (parallel/pjit_mesh) — bit-identical counts/traces
+                from .parallel.pjit_mesh import PjitShardedEngine
+                eng = PjitShardedEngine(cfg, chunk=args.chunk,
+                                        store_states=not args.no_store,
+                                        archive_dir=args.archive_dir,
+                                        **burst_kw)
             else:
                 eng = Engine(cfg, chunk=args.chunk,
                              store_states=not args.no_store,
@@ -775,6 +789,17 @@ def cmd_batch(args):
         print(f"--wave-yield must be >= 1 (got {args.wave_yield})",
               file=sys.stderr)
         return 2
+    if args.executable_cache_max_bytes is not None:
+        if args.executable_cache_max_bytes <= 0:
+            print(f"--executable-cache-max-bytes must be positive "
+                  f"(got {args.executable_cache_max_bytes}); omit it "
+                  "for an unbounded cache", file=sys.stderr)
+            return 2
+        if not args.executable_cache:
+            print("--executable-cache-max-bytes bounds the on-disk "
+                  "executable cache: add --executable-cache",
+                  file=sys.stderr)
+            return 2
     err = _check_retry_flags(args) or _install_chaos(args)
     if err:
         print(err, file=sys.stderr)
@@ -782,6 +807,12 @@ def cmd_batch(args):
     cache = ResultCache(args.cache_dir,
                         max_bytes=args.cache_max_bytes) \
         if args.cache_dir else None
+    exec_cache = None
+    if args.executable_cache:
+        from .serve.exec_cache import ExecCache
+        exec_cache = ExecCache(
+            args.executable_cache,
+            max_bytes=args.executable_cache_max_bytes)
     obs = _build_obs(args)
     obs.start()
     done = False
@@ -797,7 +828,7 @@ def cmd_batch(args):
                                verbose=args.verbose,
                                wave_state=args.wave_state,
                                wave_yield=args.wave_yield,
-                               exec_cache=args.executable_cache)
+                               exec_cache=exec_cache)
                 done = True
                 break
             except RETRYABLE as e:
@@ -918,6 +949,15 @@ def main(argv=None):
                     help="host-spill engine: stream levels through "
                          "host RAM (TLC's disk-spill counterpart) — "
                          "required past the single-chip HBM depth wall")
+    pc.add_argument("--pjit", action="store_true",
+                    help="pod-scale pjit engine (parallel/pjit_mesh): "
+                         "the whole BFS state lives under named "
+                         "shardings on a mesh spanning every host's "
+                         "devices (multi-controller runs span hosts "
+                         "after jax.distributed.initialize), with the "
+                         "hash-ownership dedup exchange compiled as "
+                         "in-program collectives; counts/gids/traces "
+                         "are bit-identical to the default engine")
     pc.add_argument("--seg", type=int, default=1 << 21,
                     help="spill segment capacity in states (--spill)")
     pc.add_argument("--host-table", action="store_true",
@@ -929,6 +969,18 @@ def main(argv=None):
                          "breaks the ~2^29-slot HBM dedup ceiling "
                          "(TLC's disk-spillable fingerprint set "
                          "counterpart)")
+    pc.add_argument("--sweep-stage",
+                    action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="double-buffered pre-sweep H2D staging "
+                         "(--host-table): issue the next sweep's "
+                         "partition-image uploads at level start so "
+                         "the DMA overlaps the level's compute "
+                         "instead of serializing inside the sweep "
+                         "(h2d_stage/sweep_overlap spans on the "
+                         "ledger/timeline; counts are identical "
+                         "either way — --no-sweep-stage is the A/B "
+                         "reference)")
     pc.add_argument("--partitions", type=int, default=4, metavar="P",
                     help="host-table partition count, a power of two "
                          "(counts are P-invariant; P sizes the "
@@ -1144,6 +1196,15 @@ def main(argv=None):
                          "every entry reads as a labeled miss "
                          "(counted in the summary/ledger), never a "
                          "crash")
+    pb.add_argument("--executable-cache-max-bytes", type=int,
+                    default=None, metavar="N",
+                    help="LRU-by-bytes bound on the executable cache "
+                         "directory (entries are MBs each on TPU): "
+                         "every store trims --executable-cache back "
+                         "under N bytes, least-recently-USED entries "
+                         "first (recency = mtime, refreshed on warm "
+                         "loads; the just-stored entry is never the "
+                         "victim; default: unbounded)")
     pb.add_argument("--sequential", action="store_true",
                     help="run each job on its own engine instead of "
                          "the batched path (the honest A/B reference "

@@ -62,6 +62,7 @@ from ..utils import cat_arrays as _cat
 from ..utils import fmix32_int as _fmix32_int
 from ..utils import fp_key
 from ..utils import take_arrays as _take
+from . import driver
 from .expand import Expander
 from .fingerprint import Fingerprinter, fmix32
 
@@ -448,6 +449,7 @@ class Engine:
                  guard_matmul: bool = True,
                  dedup_kernel: str = "auto",
                  delta_matmul: bool = True,
+                 delta_chunk_skip: Optional[bool] = None,
                  fam_density: Optional[Dict[str, int]] = None):
         enable_persistent_compilation_cache()
         self.cfg = cfg
@@ -487,8 +489,14 @@ class Engine:
         # construction, delta_matmul=False restores the per-family
         # kernel path for every family
         self.delta_matmul = bool(delta_matmul)
+        # delta_chunk_skip: per-family lax.cond blocks that skip a
+        # family's whole delta-group slice when a chunk enables none of
+        # its lanes (None = follow the backend default: ON under the
+        # TPU MXU lowering, OFF under the CPU scatter-add — see the
+        # Expander docstring; bit-exact either way)
         self.expander = Expander(cfg, guard_matmul=self.guard_matmul,
-                                 delta_matmul=self.delta_matmul)
+                                 delta_matmul=self.delta_matmul,
+                                 delta_chunk_skip=delta_chunk_skip)
         # Pallas probe/claim dedup kernel (fingerprint.py): "auto"
         # engages it on TPU only (the gather/scatter lax sequence stays
         # the CPU program — the kernel's interpret=True fallback exists
@@ -594,6 +602,14 @@ class Engine:
     def _round_cap(self, n: int) -> int:
         c = self.chunk
         return ((int(n) + c - 1) // c) * c
+
+    def _fetch(self, x) -> np.ndarray:
+        """Device array -> host numpy, engine-overridable: the harvest
+        paths route every device read through here so an engine whose
+        state lives under multi-host shardings (parallel/pjit_mesh) can
+        gather to a replicated (every-controller-addressable) array
+        first.  The base engines' arrays are process-local already."""
+        return np.asarray(x)
 
     # ------------------------------------------------------------------
     # phase 1: expand + action constraints + fingerprint (also used by
@@ -1715,6 +1731,7 @@ class Engine:
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
               resume_from: Optional[str] = None,
+              resume_image=None,
               verbose: bool = False, obs=None) -> CheckResult:
         """seed_states entries are (State, Hist) pairs or raw SoA dicts
         (the latter preserve feature lanes exactly — engine-emitted
@@ -1725,12 +1742,24 @@ class Engine:
         checkpointed run (final counts are identical to an
         uninterrupted run; levels are never half-resumed).
 
+        resume_image — a ``resil.portable.PortableImage`` from ANY
+        engine family's checkpoint (round 12 contract): the visited
+        key SET rebuilds this engine's table image and the gid-ordered
+        frontier rows re-home into the level-buffer layout, so a mesh
+        or spill checkpoint resumes here (and, via
+        parallel/pjit_mesh's inherited override, onto a pod-spanning
+        pjit mesh) landing on the exact counts of an uninterrupted
+        run.
+
         obs — an ``obs.Obs`` bundle (spans / JSONL ledger / heartbeat /
         profiler hooks); every dispatch writes one ledger record and
         one heartbeat rewrite, so a killed run keeps its telemetry."""
         obs = self._obs = obs if obs is not None else NULL_OBS
         t0 = time.perf_counter()
         lay = self.lay
+        if resume_from is not None and resume_image is not None:
+            raise ValueError(
+                "resume_from and resume_image are mutually exclusive")
 
         def prewarm(obs):
             # per-level executables warm at run start, inside a compile
@@ -1760,6 +1789,11 @@ class Engine:
             n_vis = meta["n_vis"]
             depth = meta["depth"]
             n_front = meta["n_front"]
+            resumed = True
+        elif resume_image is not None:
+            (carry, res, depth, n_states, n_vis,
+             n_front) = self._resume_portable(resume_image)
+            prewarm(obs)
             resumed = True
         else:
             self._init_store()
@@ -1846,13 +1880,14 @@ class Engine:
                 # batch-major numpy (host layout) — decode/trace/_take
                 # row-index them.
                 self._archive_level(
-                    np.asarray(carry["lpar"][:n_lvl]),
-                    np.asarray(carry["llane"][:n_lvl]),
-                    {k: np.moveaxis(np.asarray(v[..., :n_lvl]), -1, 0)
+                    self._fetch(carry["lpar"][:n_lvl]),
+                    self._fetch(carry["llane"][:n_lvl]),
+                    {k: np.moveaxis(self._fetch(v[..., :n_lvl]), -1, 0)
                      for k, v in carry["front"].items()})
             if n_viol:
-                inv_ok = np.asarray(out["inv_ok"])[:, :n_lvl]
-                rows = {k: np.moveaxis(np.asarray(v[..., :n_lvl]), -1, 0)
+                inv_ok = self._fetch(out["inv_ok"])[:, :n_lvl]
+                rows = {k: np.moveaxis(self._fetch(v[..., :n_lvl]),
+                                       -1, 0)
                         for k, v in carry["front"].items()}
                 for j, nm in enumerate(self.inv_names):
                     for s in np.nonzero(~inv_ok[j])[0]:
@@ -1865,10 +1900,7 @@ class Engine:
             n_vis += n_lvl
             # global state ids are device int32 (gids/lpar); fail loud
             # rather than wrap if a run ever approaches that scale
-            if n_states >= 2 ** 31 - 1:
-                raise RuntimeError(
-                    "state-id space exhausted (2^31 ids): run exceeds "
-                    "the engine's int32 global-id width")
+            driver.guard_id_space(n_states)
             return n_front
 
         if not resumed:
@@ -1925,63 +1957,37 @@ class Engine:
                     with obs.span("harvest"):
                         par_h = lane_h = st_h = inv_h = None
                         if self.store_states or viol_any:
-                            par_h = np.asarray(bout["par"])
-                            lane_h = np.asarray(bout["lane"])
-                            st_h = {k: np.asarray(v)
+                            par_h = self._fetch(bout["par"])
+                            lane_h = self._fetch(bout["lane"])
+                            st_h = {k: self._fetch(v)
                                     for k, v in bout["st"].items()}
-                            inv_h = np.asarray(bout["inv"])
-                        for li in range(nlev):
-                            n_lvl, n_viol, faults, n_expand, n_genl = (
-                                int(x) for x in stats[li, :5])
-                            res.distinct_states += n_lvl
-                            res.generated_states += n_genl
-                            res.overflow_faults += faults
-                            res.violations_global += n_viol
+                            inv_h = self._fetch(bout["inv"])
+
+                        def _arch(li, n_lvl):
                             if self.store_states:
                                 self._archive_level(
-                                    par_h[li, :n_lvl].copy(),
-                                    lane_h[li, :n_lvl].copy(),
-                                    {k: np.moveaxis(
-                                        v[..., li, :n_lvl],
-                                        -1, 0).copy()
-                                     for k, v in st_h.items()})
-                            if n_viol:
-                                rows = {k: np.moveaxis(
-                                            v[..., li, :n_lvl], -1, 0)
-                                        for k, v in st_h.items()}
-                                for j, nm in enumerate(self.inv_names):
-                                    for s in np.nonzero(
-                                            ~inv_h[j, li, :n_lvl])[0]:
-                                        vsv, vh = self.ir.decode(
-                                            self.lay, _take(rows, s))
-                                        res.violations.append(Violation(
-                                            nm, n_states + int(s),
-                                            state=vsv, hist=vh))
-                            if n_lvl == 0 and n_genl == 0:
-                                pass     # all-pruned frontier: not a
-                                # level
-                            else:
-                                depth += 1
-                                # counted HERE, not as the raw
-                                # loop-trip count, so levels_fused ≡
-                                # depth advanced and bench's
-                                # (depth - levels_fused) is the
-                                # per-level-driver level count exactly
-                                res.levels_fused += 1
-                                res.level_sizes.append(n_expand)
-                            n_states += n_lvl
+                                    *driver.burst_archive_slice(
+                                        par_h, lane_h, st_h, li,
+                                        n_lvl))
+
+                        def _viol(li, n_lvl, gid_base):
+                            driver.burst_decode_violations(
+                                res, self.ir, self.lay,
+                                self.inv_names, inv_h, st_h, li,
+                                n_lvl, gid_base)
+
+                        def _vis(li, n_lvl):
+                            nonlocal n_vis
                             n_vis += n_lvl
-                    if n_states >= 2 ** 31 - 1:
-                        raise RuntimeError(
-                            "state-id space exhausted (2^31 ids): run "
-                            "exceeds the engine's int32 global-id width")
+
+                        depth, n_states = driver.harvest_fused_levels(
+                            res, nlev, lambda li: stats[li, :5],
+                            depth, n_states, archive=_arch,
+                            violations=_viol, visited=_vis)
                     t_dev += time.perf_counter() - t1
-                    # fire if ANY multiple of checkpoint_every was
-                    # crossed this burst (a multi-level depth jump can
-                    # step over every exact multiple)
-                    every = max(1, checkpoint_every)
                     if checkpoint_path is not None and \
-                            depth // every > d0 // every:
+                            driver.ckpt_due_after_burst(
+                                depth, d0, checkpoint_every):
                         self._save_checkpoint(checkpoint_path, carry,
                                               res, depth, n_states,
                                               n_vis, n_front)
@@ -2083,19 +2089,15 @@ class Engine:
             self.famx_max = [max(a, b) for a, b in zip(
                 getattr(self, "famx_max", [0] * len(self.FAM_CAPS)),
                 scal[11:11 + len(self.FAM_CAPS)])]
-            if scal[0] == 0 and scal[6] == 0:
-                # the frontier had only constraint-pruned rows: nothing
-                # was even generated, so this is not a BFS level (the
-                # oracle's frontier excludes pruned rows and would not
-                # have run it).  An all-duplicates level (n_gen > 0)
-                # DOES count, matching the oracle.
-                depth -= 1
-            else:
-                # post-constraint frontier size, the oracle's metric
-                res.level_sizes.append(scal[7])
+            # the shared depth gate (engine/driver docstring): an
+            # all-pruned pseudo-level advances no depth; a real level
+            # appends the post-constraint frontier size (the oracle's
+            # metric)
+            depth = driver.gate_level_depth(res, depth, scal[0],
+                                            scal[6], scal[7])
             t_dev += time.perf_counter() - t1
             if checkpoint_path is not None and \
-                    depth % max(1, checkpoint_every) == 0:
+                    driver.ckpt_due_at_level(depth, checkpoint_every):
                 self._save_checkpoint(checkpoint_path, carry, res,
                                       depth, n_states, n_vis, n_front)
             obs.dispatch(kind="level", depth=depth, frontier=n_front,
@@ -2185,6 +2187,107 @@ class Engine:
         res = ckpt_result(z, meta)
         z.close()             # all arrays extracted; don't leak the fd
         return carry, res, meta
+
+    # ------------------------------------------------------------------
+    # shape-portable resume (resil/portable round-12 contract): any
+    # engine family's checkpoint re-homes into this engine's layout —
+    # the key SET rebuilds the table image (membership is a set
+    # property, slot layout never matters), the gid-ordered frontier
+    # rows land in the level-buffer positions their contiguous ids
+    # dictate, and archives/counters attach unchanged.  The pjit mesh
+    # engine inherits this wholesale and re-partitions via
+    # _commit_carry.
+    # ------------------------------------------------------------------
+
+    def _commit_carry(self, carry):
+        """Final placement hook for host-assembled carries: identity
+        here; parallel/pjit_mesh re-partitions onto its named
+        shardings."""
+        return carry
+
+    def _seed_table_from_keys(self, keys_np: np.ndarray):
+        """[N, W] u32 visited keys -> a fresh (vis, claims) pair at the
+        CURRENT self.VCAP via the bulk lax claim walk (the reseed
+        discipline of engine/spill: whole-cohort inserts stay on the
+        lax path; dedup needs membership, not the original slot
+        layout)."""
+        n = int(keys_np.shape[0])
+        nq = 1 << max(10, _ceil_log2(max(n, 2)))
+        kq = np.full((self.W, nq), np.uint32(0xFFFFFFFF), np.uint32)
+        if n:
+            kq[:, :n] = keys_np.T
+        VCAP, W = self.VCAP, self.W
+        fn = getattr(self, "_seed_table_cache", None)
+        if fn is None:
+            fn = self._seed_table_cache = {}
+        impl = fn.get((VCAP, nq))
+        if impl is None:
+            def build(keys, n):
+                table = tuple(jnp.full((VCAP,), U32MAX)
+                              for _ in range(W))
+                claims = jnp.full((VCAP,), U32MAX)
+                live = jnp.arange(nq, dtype=jnp.int32) < n
+                ks = tuple(keys[w] for w in range(W))
+                ranks = jnp.arange(nq, dtype=jnp.uint32)
+                table, claims, _f, _p, hv = self._probe_insert_lax(
+                    table, claims, ks, live, ranks)
+                return table, claims, hv
+            impl = fn[(VCAP, nq)] = jax.jit(build)
+        vis, claims, hv = impl(jnp.asarray(kq), jnp.int32(n))
+        if bool(np.asarray(hv)):
+            raise RuntimeError(
+                "portable-resume table seed probe overflow — raise "
+                "vcap")
+        return vis, claims
+
+    def _resume_portable(self, img):
+        """PortableImage -> (carry, res, depth, n_states, n_vis,
+        n_front).  Refuses images whose frontier gids are not
+        contiguous (spill-family images drop pruned rows; this
+        engine's frontier layout is the full last level under fmask)
+        with a message naming the engine that can host them."""
+        from ..resil.portable import validate_image
+        validate_image(img, self.ir.name, repr(self.cfg), self.W)
+        n_front = img.n_front
+        if n_front:
+            gids = np.asarray(img.gids, np.int64)
+            pg_off = int(gids[0])
+            if not np.array_equal(
+                    gids, pg_off + np.arange(n_front, dtype=np.int64)):
+                raise CheckpointError(
+                    f"{img.source_path}: portable image's frontier "
+                    "gids are not contiguous (a spill-family image "
+                    "drops constraint-pruned rows); this engine's "
+                    "frontier layout needs the full last level — "
+                    "resume it with the spill engine "
+                    "(check --spill --resume F --resume-portable)")
+        else:
+            pg_off = img.n_states
+        # capacity sizing follows the fresh-start discipline
+        # (capacities shape overflow replays, never counts)
+        while self.LCAP - self.OCAP < 2 * max(n_front, 1):
+            self.LCAP *= 2
+        while img.n_vis + self.LCAP - self.OCAP > \
+                self._LOAD_MAX * self.VCAP:
+            self.VCAP *= 4
+        self._restore_portable_archives(img)
+        carry = self._fresh_carry(self.LCAP, self.VCAP)
+        carry["vis"], carry["claims"] = self._seed_table_from_keys(
+            img.keys)
+        if n_front:
+            rows_T = {k: np.moveaxis(np.asarray(v), 0, -1)
+                      for k, v in img.rows.items()}
+            carry["front"] = {
+                k: v.at[..., :n_front].set(jnp.asarray(rows_T[k]))
+                for k, v in carry["front"].items()}
+            carry["fmask"] = carry["fmask"].at[:n_front].set(
+                jnp.asarray(np.asarray(img.con, bool)))
+        carry["n_front"] = jnp.int32(n_front)
+        carry["pg_off"] = jnp.int32(pg_off)
+        carry["g_off"] = jnp.int32(img.n_states)
+        carry = self._commit_carry(carry)
+        return (carry, img.fresh_result(), img.depth, img.n_states,
+                img.n_vis, n_front)
 
     # ------------------------------------------------------------------
 

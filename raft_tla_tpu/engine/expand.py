@@ -158,7 +158,8 @@ class Expander:
     every family — tests/test_delta_matmul.py pins ON ≡ OFF."""
 
     def __init__(self, cfg, guard_matmul: bool = True,
-                 delta_matmul: bool = True):
+                 delta_matmul: bool = True,
+                 delta_chunk_skip: Optional[bool] = None):
         self.cfg = cfg
         self.ir = spec_of(cfg)
         self.lay = self.ir.make_layout(cfg)
@@ -171,6 +172,23 @@ class Expander:
         # P-contraction lowering: the MXU matmul on TPU, the
         # bit-identical static scatter-add off-TPU (see _delta_of)
         self._delta_mxu = jax.default_backend() == "tpu"
+        # chunk skip (the ROADMAP item-3 leftover): apply the delta
+        # group as per-family blocks, each under a lax.cond on the
+        # chunk's enabled count, so a chunk that enables NONE of a
+        # family's lanes skips that family's whole cap-wide block —
+        # today's group width is the sum of declared fam caps even
+        # then.  Bit-exact: an enabled family's block runs the same
+        # gathers and int32 adds as the fused group, and a skipped
+        # family's columns hold only compaction garbage no consumer
+        # ever reads (the same garbage-unobservability the ON≡OFF
+        # differentials already rest on).  Default follows the MXU
+        # lowering — the cond buys back dense matmul width on TPU,
+        # while off-TPU the always-apply single block keeps the
+        # cheaper-to-compile graph; tests force it ON under CPU to pin
+        # exactness.
+        self.delta_chunk_skip = (self._delta_mxu
+                                 if delta_chunk_skip is None
+                                 else bool(delta_chunk_skip))
         self._gW, self._gT = self._build_guard_matrix()
         self._dgroup = self._build_delta_group() if self.delta_matmul \
             else None
@@ -281,6 +299,7 @@ class Expander:
         OFF["_feat"] = dict(foff)    # the spec's feature offset table
         t_lane, t_slot, t_src, t_w = [], [], [], []
         fam_idx, lane_base = [], {}
+        fam_trng = {}                # fi -> the family's triple range
         lane_to_aff = np.full((self.n_lanes,), -1, np.int32)
         A_g = 0
         goff = 0                     # global lane offset
@@ -289,6 +308,7 @@ class Expander:
             if fam.delta is not None:
                 fam_idx.append(fi)
                 lane_base[fi] = A_g
+                t_lo = len(t_w)
                 lane_to_aff[goff:goff + nf] = \
                     A_g + np.arange(nf, dtype=np.int32)
                 for li, vals in enumerate(
@@ -320,6 +340,7 @@ class Expander:
                         t_slot.append(slot)
                         t_src.append(src)
                         t_w.append(int(w))
+                fam_trng[fi] = (t_lo, len(t_w))
                 A_g += nf
             goff += nf
         T = len(t_w)
@@ -348,7 +369,8 @@ class Expander:
                     t_srcu=np.asarray([src_of[s] for s in t_src],
                                       np.int32),
                     t_slot=np.asarray(t_slot, np.int32),
-                    t_w=t_wi, lane_to_aff=lane_to_aff)
+                    t_w=t_wi, lane_to_aff=lane_to_aff,
+                    fam_trng=fam_trng)
 
     def _flatten_T(self, svT) -> jnp.ndarray:
         """Batch-last state dict [..., B] -> flat int32 view [D, B]
@@ -402,6 +424,28 @@ class Expander:
             return jnp.einsum("td,tc->dc", jnp.asarray(dg["P"]), x,
                               preferred_element_type=jnp.int32)
         slots = jnp.asarray(dg["t_slot"])
+        return jnp.zeros((dg["D"], x.shape[-1]),
+                         jnp.int32).at[slots].add(x)
+
+    def _delta_of_fam(self, psi_c, selL, fi: int):
+        """_delta_of restricted to ONE family's triple range — the
+        chunk-skip path's per-family block (delta_chunk_skip; selL is
+        the family-LOCAL lane one-hot [cap, nf]).  Same sources, same
+        weights, same int32 adds as the fused group, so an enabled
+        family's columns are bit-identical to the single-block path."""
+        dg = self._dgroup
+        lo, hi = dg["fam_trng"][fi]
+        tv = psi_c[jnp.asarray(dg["t_srcu"][lo:hi])] * \
+            jnp.asarray(dg["t_w"][lo:hi])[:, None]        # [Tf, cap]
+        own = jnp.transpose(selL)[
+            jnp.asarray(dg["t_lane"][lo:hi]
+                        - dg["lane_base"][fi])]
+        x = own * tv
+        if self._delta_mxu:
+            return jnp.einsum("td,tc->dc",
+                              jnp.asarray(dg["P"][lo:hi]), x,
+                              preferred_element_type=jnp.int32)
+        slots = jnp.asarray(dg["t_slot"][lo:hi])
         return jnp.zeros((dg["D"], x.shape[-1]),
                          jnp.int32).at[slots].add(x)
 
@@ -680,56 +724,112 @@ class Expander:
         g_cand = None
         if dg is not None:
             with jax.named_scope("delta_apply"):
-                gb_parts, gl_parts = [], []
-                for fi in dg["fam_idx"]:
-                    nf = self.families[fi].n_lanes
-                    lo = int(coff_np[fi])
-                    cap = fam_caps[fi]
-                    gb_parts.append(b_all[lo:lo + cap])
-                    gl_parts.append(jnp.clip(
-                        l_all[lo:lo + cap] - fam_off[fi], 0, nf - 1)
-                        + dg["lane_base"][fi])
                 # barrier the block's inputs as well as its output:
                 # the compaction indices and the flat/psi views
                 # otherwise fuse into the one-hot einsums and the
                 # fusion search dominates compile time (~1.3s per
                 # traced program on XLA:CPU) — identity ops, bit-exact
-                gb, gl = jax.lax.optimization_barrier(
-                    (jnp.concatenate(gb_parts),
-                     jnp.concatenate(gl_parts)))
                 xflat = jax.lax.optimization_barrier(
                     self._flatten_T(svT))
                 psi = jax.lax.optimization_barrier(
                     self._psi_T(svT, derT, xflat))
-                selL = (gl[:, None] ==
-                        jnp.arange(dg["n_lanes"],
-                                   dtype=jnp.int32)[None, :]) \
-                    .astype(jnp.int32)                    # [gcap, A_g]
-                if self._delta_mxu:
-                    # row selection as one-hot matmuls (the PR-8
-                    # _sel_rows trick, whole group at once)
-                    selB = (gb[:, None] ==
-                            jnp.arange(B, dtype=jnp.int32)[None, :]) \
-                        .astype(jnp.int32)                # [gcap, B]
-                    rows_flat = jnp.einsum(
-                        "db,cb->dc", xflat, selB,
-                        preferred_element_type=jnp.int32)
-                    vals = jnp.einsum(
-                        "eb,cb->ec", psi, selB,
-                        preferred_element_type=jnp.int32)
+                if self.delta_chunk_skip:
+                    # chunk skip (the ROADMAP item-3 leftover): one
+                    # block per family, each under a cond on the
+                    # chunk's enabled count — a chunk enabling none of
+                    # a family's lanes skips its whole cap-wide block
+                    # instead of paying the full group width.  An
+                    # enabled family's block runs the identical
+                    # gathers/adds as the fused group (bit-exact); a
+                    # skipped family's columns were compaction garbage
+                    # no consumer reads either way.
+                    out_parts, par_parts = [], []
+                    for fi in dg["fam_idx"]:
+                        nf = self.families[fi].n_lanes
+                        lo = int(coff_np[fi])
+                        cap = fam_caps[fi]
+                        gb_f, gl_f = jax.lax.optimization_barrier(
+                            (b_all[lo:lo + cap],
+                             jnp.clip(l_all[lo:lo + cap]
+                                      - fam_off[fi], 0, nf - 1)))
+
+                        def _apply(ops, fi=fi, nf=nf):
+                            xf, ps, gb, gl = ops
+                            selL = (gl[:, None] ==
+                                    jnp.arange(nf, dtype=jnp.int32)
+                                    [None, :]).astype(jnp.int32)
+                            if self._delta_mxu:
+                                selB = (gb[:, None] ==
+                                        jnp.arange(B, dtype=jnp.int32)
+                                        [None, :]).astype(jnp.int32)
+                                rows = jnp.einsum(
+                                    "db,cb->dc", xf, selB,
+                                    preferred_element_type=jnp.int32)
+                                vals = jnp.einsum(
+                                    "eb,cb->ec", ps, selB,
+                                    preferred_element_type=jnp.int32)
+                            else:
+                                rows = xf[:, gb]
+                                vals = ps[:, gb]
+                            return rows, rows + self._delta_of_fam(
+                                vals, selL, fi)
+
+                        def _skip(ops, cap=cap):
+                            z = jnp.zeros((dg["D"], cap), jnp.int32)
+                            return z, z
+
+                        par_f, out_f = jax.lax.cond(
+                            counts[fi] > 0, _apply, _skip,
+                            (xflat, psi, gb_f, gl_f))
+                        par_parts.append(par_f)
+                        out_parts.append(out_f)
+                    out_flat = jax.lax.optimization_barrier(
+                        jnp.concatenate(out_parts, axis=-1))
+                    rows_flat = jnp.concatenate(par_parts, axis=-1)
                 else:
-                    # off-TPU: the bit-identical column gather (each
-                    # embedded dot costs ~1s of XLA:CPU compile)
-                    rows_flat = xflat[:, gb]
-                    vals = psi[:, gb]
-                # the barrier stops XLA fusing the delta matmul into
-                # its ~n_keys × n_families unflatten/concat consumers —
-                # without it the fusion search costs ~1.3s of compile
-                # per traced program (same class as the phase barriers
-                # in engine/bfs._chunk_step_impl); identity, so the
-                # bit-exactness contract is untouched
-                out_flat = jax.lax.optimization_barrier(
-                    rows_flat + self._delta_of(vals, selL))
+                    gb_parts, gl_parts = [], []
+                    for fi in dg["fam_idx"]:
+                        nf = self.families[fi].n_lanes
+                        lo = int(coff_np[fi])
+                        cap = fam_caps[fi]
+                        gb_parts.append(b_all[lo:lo + cap])
+                        gl_parts.append(jnp.clip(
+                            l_all[lo:lo + cap] - fam_off[fi],
+                            0, nf - 1) + dg["lane_base"][fi])
+                    gb, gl = jax.lax.optimization_barrier(
+                        (jnp.concatenate(gb_parts),
+                         jnp.concatenate(gl_parts)))
+                    selL = (gl[:, None] ==
+                            jnp.arange(dg["n_lanes"],
+                                       dtype=jnp.int32)[None, :]) \
+                        .astype(jnp.int32)                # [gcap, A_g]
+                    if self._delta_mxu:
+                        # row selection as one-hot matmuls (the PR-8
+                        # _sel_rows trick, whole group at once)
+                        selB = (gb[:, None] ==
+                                jnp.arange(B, dtype=jnp.int32)
+                                [None, :]).astype(jnp.int32)
+                        rows_flat = jnp.einsum(
+                            "db,cb->dc", xflat, selB,
+                            preferred_element_type=jnp.int32)
+                        vals = jnp.einsum(
+                            "eb,cb->ec", psi, selB,
+                            preferred_element_type=jnp.int32)
+                    else:
+                        # off-TPU: the bit-identical column gather
+                        # (each embedded dot costs ~1s of XLA:CPU
+                        # compile)
+                        rows_flat = xflat[:, gb]
+                        vals = psi[:, gb]
+                    # the barrier stops XLA fusing the delta matmul
+                    # into its ~n_keys × n_families unflatten/concat
+                    # consumers — without it the fusion search costs
+                    # ~1.3s of compile per traced program (same class
+                    # as the phase barriers in
+                    # engine/bfs._chunk_step_impl); identity, so the
+                    # bit-exactness contract is untouched
+                    out_flat = jax.lax.optimization_barrier(
+                        rows_flat + self._delta_of(vals, selL))
                 # ONE unflatten for the whole group buffer; families
                 # slice their column ranges out of the shaped arrays
                 # (slices are far cheaper to trace than per-family

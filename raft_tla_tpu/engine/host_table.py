@@ -174,6 +174,12 @@ class HostPartitionedTable:
             np.full((self.W, part_cap), U32, np.uint32)
             for _ in range(self.P)]
         self.counts: List[int] = [0] * self.P
+        # per-partition mutation version: bumped on every rehash and
+        # every commit, so a device-staged copy of an image (the spill
+        # engine's double-buffered pre-sweep upload) can verify it is
+        # still current before serving membership probes — an aliased
+        # or stale upload is discarded, never probed
+        self.vers: List[int] = [0] * self.P
 
     # -- key bucketing -------------------------------------------------
 
@@ -212,6 +218,7 @@ class HostPartitionedTable:
         keys = old[:, occ].T.copy()              # slot order: stable
         self.imgs[p] = np.full((self.W, cap), U32, np.uint32)
         insert_np(self.imgs[p], keys)
+        self.vers[p] += 1
         return True
 
     # -- host-side sweep (mesh composition + differential tests) -------
@@ -236,6 +243,7 @@ class HostPartitionedTable:
             self.reserve(int(p), kp.shape[0])
             insert_np(self.imgs[int(p)], kp)
             self.counts[int(p)] += int(kp.shape[0])
+            self.vers[int(p)] += 1
 
     def sweep(self, keys: np.ndarray) -> np.ndarray:
         """Level sweep, host path: returns keep = ~member and commits

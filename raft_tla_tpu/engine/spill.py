@@ -64,6 +64,7 @@ from jax import lax
 from ..config import ModelConfig
 from ..ops.codec import C_OVERFLOW
 from ..obs import NULL_OBS
+from . import driver
 from .bfs import (CheckResult, CheckpointError, Engine, U32MAX,
                   _HOME_SALT, Violation, ckpt_read, ckpt_result,
                   ckpt_write)
@@ -95,6 +96,7 @@ class SpillEngine(Engine):
                  host_table: bool = False, partitions: int = 4,
                  part_cap: int = 1 << 12,
                  dev_keys: Optional[int] = None,
+                 sweep_stage: bool = True,
                  burst: bool = True,
                  burst_levels: Optional[int] = None,
                  archive_dir: Optional[str] = None,
@@ -147,6 +149,21 @@ class SpillEngine(Engine):
         self.dev_keys = (int(dev_keys) if dev_keys
                          else int(self._LOAD_MAX * self.VCAP))
         self.hpt = None                # built per check()/resume
+        # double-buffered pre-sweep H2D staging (round 14): the next
+        # level's partition-image uploads are ISSUED at level start, so
+        # the DMA rides the host link while the level's chunks compute
+        # instead of serializing after them inside the sweep
+        # (_stage_sweep_images; h2d_stage/sweep_overlap spans make the
+        # overlap visible in the PR-7 ledger/timeline).  At most
+        # _SWEEP_STAGE_DEPTH images are in flight (double-buffering —
+        # HBM holds the staged image next to the sweep's own working
+        # set); a staged image serves a sweep only when its partition's
+        # mutation version still matches (host_table.vers), so growth
+        # or commit can never hand the device a stale membership image.
+        self.sweep_stage = bool(sweep_stage)
+        self._sweep_staged = {}        # partition -> (dev_img, version)
+        self.sweep_stage_hits = 0      # sweeps served from a prestage
+        self.sweep_stage_misses = 0    # inline (serialized) uploads
         self._paste_cache = {}         # upload-paste jit per block size
         self._slice_cache = {}         # spill-slice jit per block size
         self._ckpt_sparse_cache = {}   # sparse-table jit per size
@@ -579,6 +596,39 @@ class SpillEngine(Engine):
         with self._obs.span("host_sweep"):
             return self._sweep_level_keys_impl(keys)
 
+    _SWEEP_STAGE_DEPTH = 2
+
+    def _stage_sweep_images(self):
+        """Issue async H2D uploads of the NEXT sweep's first partition
+        images (ascending partition order — the sweep's plan order) up
+        to the double-buffer depth.  Called at level start inside the
+        level_dispatch window: the ``h2d_stage`` span then visibly
+        overlaps the level's compute spans on the timeline, which is
+        the point — the upload cost leaves the sweep's critical path.
+        device_put returns immediately (the transfer drains in the
+        background); the version tag recorded here is what lets the
+        sweep trust (or discard) the image later."""
+        if not (self.sweep_stage and self.host_table
+                and self.hpt is not None):
+            return
+        if getattr(self, "_staged_for", None) is not self.hpt:
+            # a fresh/resumed check rebuilt the partitions: any staged
+            # images belong to the OLD table object — drop them (the
+            # version counters of a new table restart at 0 and could
+            # alias)
+            self._sweep_staged = {}
+            self._staged_for = self.hpt
+        todo = [p for p in range(self.hpt.P)
+                if p not in self._sweep_staged]
+        room = self._SWEEP_STAGE_DEPTH - len(self._sweep_staged)
+        if room <= 0 or not todo:
+            return
+        with self._obs.span("h2d_stage"):
+            for p in todo[:room]:
+                self._sweep_staged[p] = (
+                    jax.device_put(self.hpt.imgs[p]),
+                    self.hpt.vers[p])
+
     def _sweep_level_keys_impl(self, keys: np.ndarray) -> np.ndarray:
         n_all = keys.shape[0]
         keep = np.ones(n_all, bool)
@@ -598,8 +648,21 @@ class SpillEngine(Engine):
                 p, idx = plan[j]
                 # grow BEFORE the upload so the device image honors the
                 # probe-budget load bound even after this level commits
-                hpt.reserve(p, int(idx.size))
-                staged[j] = jax.device_put(hpt.imgs[p])
+                grew = hpt.reserve(p, int(idx.size))
+                pre = self._sweep_staged.pop(p, None)
+                if pre is not None and not grew and \
+                        pre[1] == hpt.vers[p]:
+                    # the image was prestaged during the level's
+                    # compute (and is provably current): its H2D
+                    # already rode the link — the sweep_overlap span
+                    # marks the serialized upload this sweep skipped
+                    with self._obs.span("sweep_overlap"):
+                        staged[j] = pre[0]
+                    self.sweep_stage_hits += 1
+                else:
+                    staged[j] = jax.device_put(hpt.imgs[p])
+                    if self.sweep_stage:
+                        self.sweep_stage_misses += 1
 
         stage(0)
         pending = []
@@ -745,48 +808,27 @@ class SpillEngine(Engine):
                 lane_h = np.asarray(out["lane"])
                 st_h = {k: np.asarray(v) for k, v in out["st"].items()}
                 inv_h = np.asarray(out["inv"])
-            for li in range(nlev):
-                n_lvl, n_viol, faults, n_expand, n_genl = (
-                    int(x) for x in stats[li, :5])
-                res.distinct_states += n_lvl
-                res.generated_states += n_genl
-                res.overflow_faults += faults
-                res.violations_global += n_viol
+
+            def _arch(li, n_lvl):
                 if self.store_states and n_lvl:
                     # n_lvl == 0 appends nothing: the spill archive's
                     # gid->row mapping is cumulative, not per-level
                     # (flush_archives skips empty levels the same way)
-                    self._archive_level(
-                        par_h[li, :n_lvl].copy(),
-                        lane_h[li, :n_lvl].copy(),
-                        {k: np.moveaxis(v[..., li, :n_lvl],
-                                        -1, 0).copy()
-                         for k, v in st_h.items()})
-                if n_viol:
-                    rows = {k: np.moveaxis(v[..., li, :n_lvl], -1, 0)
-                            for k, v in st_h.items()}
-                    for j, nm in enumerate(self.inv_names):
-                        for s in np.nonzero(~inv_h[j, li, :n_lvl])[0]:
-                            vsv, vh = self.ir.decode(
-                                lay, {kk: np.asarray(rows[kk][s])
-                                      for kk in rows})
-                            res.violations.append(Violation(
-                                nm, n_states + int(s), state=vsv,
-                                hist=vh))
-                if n_lvl or n_genl:
-                    depth += 1
-                    # counted inside the depth gate (engine/bfs does
-                    # the same) so levels_fused ≡ depth advanced in
-                    # every engine and (depth - levels_fused) is
-                    # exactly the per-level-driver level count
-                    res.levels_fused += 1
-                    res.level_sizes.append(n_expand)
-                n_states += n_lvl
+                    self._archive_level(*driver.burst_archive_slice(
+                        par_h, lane_h, st_h, li, n_lvl))
+
+            def _viol(li, n_lvl, gid_base):
+                driver.burst_decode_violations(
+                    res, self.ir, lay, self.inv_names, inv_h, st_h,
+                    li, n_lvl, gid_base)
+
+            def _vis(li, n_lvl):
+                nonlocal n_vis
                 n_vis += n_lvl
-        if n_states >= 2 ** 31 - 1:
-            raise RuntimeError(
-                "state-id space exhausted (2^31 ids): run exceeds "
-                "the engine's int32 global-id width")
+
+            depth, n_states = driver.harvest_fused_levels(
+                res, nlev, lambda li: stats[li, :5], depth, n_states,
+                archive=_arch, violations=_viol, visited=_vis)
         # rebuild the host frontier from the surviving ring: pruned
         # rows drop here (prune-not-expand stays host-side outside the
         # burst, exactly as if the level had spilled)
@@ -860,6 +902,7 @@ class SpillEngine(Engine):
                 self.hpt = HostPartitionedTable(
                     self.W, partitions=self.partitions,
                     part_cap=self.part_cap)
+                self._sweep_staged = {}
             # ---- roots (shared admit path: engine/bfs._dedup_roots) --
             roots, rk, pin_interiors = self._dedup_roots(seed_states)
             n_roots = len(rk)
@@ -938,10 +981,7 @@ class SpillEngine(Engine):
             if self.store_states:
                 self._lvl_parts[-1].append(blk)
             n_states += n
-            if n_states >= 2 ** 31 - 1:
-                raise RuntimeError(
-                    "state-id space exhausted (2^31 ids): run exceeds "
-                    "the engine's int32 global-id width")
+            driver.guard_id_space(n_states)
             con = blk["lcon"].astype(bool)
             if con.all():
                 fk = (np.ascontiguousarray(blk["lfp"].T)
@@ -1038,11 +1078,9 @@ class SpillEngine(Engine):
                     n_vis, max_depth, max_states, verbose)
                 if fused:
                     burst_ok = not bailed
-                    # fire if ANY multiple of checkpoint_every was
-                    # crossed by the burst's multi-level depth jump
-                    every = max(1, checkpoint_every)
                     if checkpoint_path is not None and \
-                            depth // every > d0 // every:
+                            driver.ckpt_due_after_burst(
+                                depth, d0, checkpoint_every):
                         self._save_spill_checkpoint(
                             checkpoint_path, carry, res,
                             frontier_blocks, frontier_keys, depth,
@@ -1109,6 +1147,13 @@ class SpillEngine(Engine):
 
             _lvl_span = obs.span("level_dispatch")
             _lvl_span.__enter__()
+            if self.host_table:
+                # issue the level-end sweep's first partition uploads
+                # NOW: the H2D DMA overlaps this level's chunk compute
+                # (tentpole-c double-buffering; h2d_stage span nested
+                # inside this level_dispatch span = the visible
+                # overlap)
+                self._stage_sweep_images()
             seg_iter = self._resegment(frontier_blocks, self.SEGF)
             staged = next(seg_iter, None)
             staged_dev = (self._stage_segment(*staged)
@@ -1204,14 +1249,12 @@ class SpillEngine(Engine):
                             next_blocks.append((rows_b, gids_b))
                             next_keys.append(fk_b)
             flush_archives()
-            if level_new == 0 and level_gen == 0:
-                # pruned-only frontier cannot occur here (host drops
-                # pruned rows), but an empty-frontier guard keeps the
-                # depth semantics aligned with engine/bfs
-                depth -= 1
-            else:
-                res.level_sizes.append(
-                    sum(int(g.shape[0]) for _r, g in next_blocks))
+            # shared depth gate (engine/driver): a pruned-only frontier
+            # cannot occur here (host drops pruned rows), but the
+            # empty-frontier guard keeps depth semantics aligned
+            depth = driver.gate_level_depth(
+                res, depth, level_new, level_gen,
+                sum(int(g.shape[0]) for _r, g in next_blocks))
             frontier_blocks = next_blocks   # the expanded level's
             # blocks are freed here (rebind) unless archived
             frontier_keys = next_keys
@@ -1223,7 +1266,7 @@ class SpillEngine(Engine):
                          else np.zeros((0, self.W), np.uint32))
                 carry, n_vis = self._reseed_dev_table(carry, fkeys)
             if checkpoint_path is not None and \
-                    depth % max(1, checkpoint_every) == 0:
+                    driver.ckpt_due_at_level(depth, checkpoint_every):
                 self._save_spill_checkpoint(
                     checkpoint_path, carry, res, frontier_blocks,
                     frontier_keys, depth, n_states, n_vis)

@@ -84,6 +84,7 @@ except ImportError:                     # pragma: no cover - older jax
 
 from ..config import ModelConfig
 from ..obs import NULL_OBS
+from ..engine import driver
 from ..engine.bfs import (CheckResult, Engine, U32MAX, Violation, _cat,
                           _take, ckpt_archives, ckpt_carry, ckpt_read,
                           ckpt_result, ckpt_write)
@@ -989,10 +990,7 @@ class ShardedEngine(Engine):
             for d in range(D):
                 n_vis[d] += nl[d]
             # global state ids are device int32; fail loud, not wrap
-            if n_states >= 2 ** 31 - 1:
-                raise RuntimeError(
-                    "state-id space exhausted (2^31 ids): run exceeds "
-                    "the engine's int32 global-id width")
+            driver.guard_id_space(n_states)
             return int(scal[:, 3].max())
 
         if not resumed:
@@ -1051,71 +1049,61 @@ class ShardedEngine(Engine):
                         st_rows = {k: dict(local_rows(v))
                                    for k, v in bout["st"].items()}
                         inv_rows = dict(local_rows(bout["inv"]))
-                    for li in range(nlev):
+
+                    def _stats(li):
+                        return (int(stats[:, li, 0].sum()),
+                                int(stats[:, li, 1].sum()),
+                                int(stats[:, li, 2].sum()),
+                                int(stats[:, li, 3].sum()),
+                                int(stats[:, li, 4].sum()))
+
+                    def _arch(li, _n_lvl):
+                        if not self.store_states:
+                            return
                         nl = stats[:, li, 0]
-                        n_lvl = int(nl.sum())
-                        res.distinct_states += n_lvl
-                        res.violations_global += int(
-                            stats[:, li, 1].sum())
-                        res.overflow_faults += int(
-                            stats[:, li, 2].sum())
-                        res.generated_states += int(
-                            stats[:, li, 4].sum())
+                        ds = sorted(par_rows)
+                        self._parents.append(np.concatenate(
+                            [par_rows[d][li, :nl[d]] for d in ds]))
+                        self._lanes.append(np.concatenate(
+                            [lane_rows[d][li, :nl[d]] for d in ds]))
+                        self._states.append(
+                            {k: np.concatenate(
+                                [st_rows[k][d][li, :nl[d]]
+                                 for d in ds]) for k in st_rows})
+                        self._arch_segs.append(
+                            [(int(d), int(nl[d])) for d in ds])
+
+                    def _viol(li, _n_lvl, gid_base):
+                        nl = stats[:, li, 0]
                         prefix = np.cumsum(nl) - nl
-                        if self.store_states:
-                            ds = sorted(par_rows)
-                            self._parents.append(np.concatenate(
-                                [par_rows[d][li, :nl[d]] for d in ds]))
-                            self._lanes.append(np.concatenate(
-                                [lane_rows[d][li, :nl[d]]
-                                 for d in ds]))
-                            self._states.append(
-                                {k: np.concatenate(
-                                    [st_rows[k][d][li, :nl[d]]
-                                     for d in ds]) for k in st_rows})
-                            self._arch_segs.append(
-                                [(int(d), int(nl[d])) for d in ds])
-                        if stats[:, li, 1].sum():
-                            for d in sorted(inv_rows):
-                                inv_ok = inv_rows[d]
-                                for j, nm in enumerate(self.inv_names):
-                                    for s in np.nonzero(
-                                            ~inv_ok[li, :nl[d], j])[0]:
-                                        vsv, vh = self.ir.decode(
-                                            lay, _take(
-                                            {k: st_rows[k][d][li]
-                                             for k in st_rows}, s))
-                                        res.violations.append(
-                                            Violation(
-                                                nm, n_states +
-                                                int(prefix[d]) +
-                                                int(s),
-                                                state=vsv, hist=vh))
-                        n_states += n_lvl
+                        for d in sorted(inv_rows):
+                            inv_ok = inv_rows[d]
+                            for j, nm in enumerate(self.inv_names):
+                                for s in np.nonzero(
+                                        ~inv_ok[li, :nl[d], j])[0]:
+                                    vsv, vh = self.ir.decode(
+                                        lay, _take(
+                                        {k: st_rows[k][d][li]
+                                         for k in st_rows}, s))
+                                    res.violations.append(
+                                        Violation(
+                                            nm, gid_base +
+                                            int(prefix[d]) + int(s),
+                                            state=vsv, hist=vh))
+
+                    def _vis(li, _n_lvl):
                         for d in range(D):
-                            n_vis[d] += nl[d]
-                        if n_lvl == 0 and \
-                                int(stats[:, li, 4].sum()) == 0:
-                            pass   # all-pruned frontier: not a level
-                        else:
-                            depth += 1
-                            # inside the depth gate (as engine/bfs) so
-                            # levels_fused ≡ depth advanced everywhere
-                            res.levels_fused += 1
-                            res.level_sizes.append(
-                                int(stats[:, li, 3].sum()))
+                            n_vis[d] += stats[d, li, 0]
+
+                    depth, n_states = driver.harvest_fused_levels(
+                        res, nlev, _stats, depth, n_states,
+                        archive=_arch, violations=_viol,
+                        visited=_vis)
                     _hv_span.__exit__(None, None, None)
-                    if n_states >= 2 ** 31 - 1:
-                        raise RuntimeError(
-                            "state-id space exhausted (2^31 ids): run "
-                            "exceeds the engine's int32 global-id "
-                            "width")
                     n_front = int(stats[:, -1, 2].max())
-                    # fire if ANY multiple of checkpoint_every was
-                    # crossed by the burst's multi-level depth jump
-                    every = max(1, checkpoint_every)
                     if checkpoint_path is not None and \
-                            depth // every > d0 // every:
+                            driver.ckpt_due_after_burst(
+                                depth, d0, checkpoint_every):
                         self._save_checkpoint(checkpoint_path, carry,
                                               res, depth, n_states,
                                               n_vis, n_front)
@@ -1180,12 +1168,11 @@ class ShardedEngine(Engine):
             _lvl_span.__exit__(None, None, None)
             with obs.span("harvest"):
                 n_front = harvest(carry, out, scal)
-            if int(scal[:, 0].sum()) == 0 and int(scal[:, 6].sum()) == 0:
-                depth -= 1
-            else:
-                res.level_sizes.append(int(scal[:, 7].sum()))
+            depth = driver.gate_level_depth(
+                res, depth, int(scal[:, 0].sum()),
+                int(scal[:, 6].sum()), int(scal[:, 7].sum()))
             if checkpoint_path is not None and \
-                    depth % max(1, checkpoint_every) == 0:
+                    driver.ckpt_due_at_level(depth, checkpoint_every):
                 self._save_checkpoint(checkpoint_path, carry, res,
                                       depth, n_states, n_vis, n_front)
             obs.dispatch(kind="level", depth=depth, frontier=n_front,

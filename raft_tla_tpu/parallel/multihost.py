@@ -34,8 +34,10 @@ Constraints vs the single-host ShardedEngine:
   at mesh scale has a trace without a single-host re-run
   (tests/test_multihost.py::test_multihost_violation_trace).  Without
   a trace_dir, violations still print decoded states shard-locally
-  (``Violation.state``).  store_states cannot be combined with
-  checkpointing (archives are not part of the checkpoint shards).
+  (``Violation.state``).  store_states composes with checkpointing
+  (round 14): every controller's checkpoint shard carries its own
+  archive rows + device segmentation, so a resumed run's final
+  trace_dir merge equals an uninterrupted run's bit-exact.
 - Level/send/compaction capacities (lcap/fcap/scap) GROW mid-run like
   the single-host engine's: every controller takes the identical
   growth branch from the replicated scalar matrix and re-homes its

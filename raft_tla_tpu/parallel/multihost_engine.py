@@ -11,8 +11,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import ModelConfig
-from ..engine.bfs import CheckpointError, ckpt_carry, ckpt_read, \
-    ckpt_result, ckpt_write
+from ..engine.bfs import CheckpointError, ckpt_archives, ckpt_carry, \
+    ckpt_read, ckpt_result, ckpt_write
 from .mesh import ShardedEngine, _SHARDED_CKPT_FORMAT
 
 
@@ -57,18 +57,6 @@ class MultiHostEngine(ShardedEngine):
     # -- per-controller trace archives ---------------------------------
 
     def check(self, *args, **kw):
-        # bind against the real signature so positionally-passed
-        # checkpoint_path/resume_from cannot bypass the guard
-        import inspect
-        bound = inspect.signature(ShardedEngine.check).bind(
-            self, *args, **kw)
-        if self.store_states and (
-                bound.arguments.get("checkpoint_path") or
-                bound.arguments.get("resume_from")):
-            raise ValueError(
-                "store_states + checkpointing is unsupported under "
-                "MultiHostEngine (trace archives are not part of the "
-                "checkpoint shards)")
         res = super().check(*args, **kw)
         if self.store_states:
             self._write_trace_archive(res)
@@ -242,9 +230,18 @@ class MultiHostEngine(ShardedEngine):
         # names in lockstep with the fresh-carry template at load time
         carry_local = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(carry), blocks)
-        ckpt_write(self._proc_path(path), carry_local, False, [], [],
-                   [], res, dict(
+        # store_states × checkpoint (round 14): each controller's
+        # checkpoint shard carries its OWN per-level archive rows
+        # (exactly what _write_trace_archive would shard out at run
+        # end) plus the device segmentation in meta, so a resumed run
+        # keeps appending and the final trace_dir merge reproduces an
+        # uninterrupted run's archive bit-exact
+        ckpt_write(self._proc_path(path), carry_local,
+                   self.store_states, self._parents, self._lanes,
+                   self._states, res, dict(
                        sharded=True, ckpt_format=_SHARDED_CKPT_FORMAT, multihost=True,
+                       arch_segs=[[[int(d), int(n)] for d, n in segs]
+                                  for segs in self._arch_segs],
                        D=self.D, n_proc=jax.process_count(),
                        proc=jax.process_index(), d_idx=d_idx,
                        chunk=self.chunk, LB=self.LB, VB=self.VB,
@@ -295,8 +292,18 @@ class MultiHostEngine(ShardedEngine):
             return jax.make_array_from_callback(shape, sharding, cb)
 
         carry = ckpt_carry(self._proc_path(path), z, template, to_global)
-        self._parents, self._lanes, self._states = [], [], []
-        self._arch_segs = []
+        # restore this controller's archive shards (round 14: the
+        # store_states × checkpoint combination works — the shard file
+        # carries its controller's per-level rows; ckpt_archives'
+        # compatibility gates apply unchanged)
+        self._parents, self._lanes, self._states = ckpt_archives(
+            z, meta, template, self.store_states)
+        if self.store_states and meta["store_states"]:
+            self._arch_segs = [[(int(d), int(n)) for d, n in segs]
+                               for segs in meta["arch_segs"]]
+            self._arch_merged = False
+        else:
+            self._arch_segs = []
         res = ckpt_result(z, meta)
         z.close()
         return carry, res, meta

@@ -53,6 +53,7 @@ from ..engine.bfs import (CheckResult, CheckpointError, U32MAX,
                           Violation, ckpt_read, ckpt_result,
                           ckpt_write)
 from ..obs import NULL_OBS
+from ..engine import driver
 from ..engine.host_table import HostPartitionedTable, insert_np
 from ..engine.spill import SpillEngine
 from ..ops.codec import C_OVERFLOW
@@ -405,9 +406,7 @@ class SpilledShardedEngine(ShardedEngine):
                             self.inv_names[j], int(gids[s]),
                             state=vsv, hist=vh))
                 n_states += n
-                if n_states >= 2 ** 31 - 1:
-                    raise RuntimeError(
-                        "state-id space exhausted (2^31 ids)")
+                driver.guard_id_space(n_states)
                 if self.store_states:
                     # archive part in gid order (this loop assigns gids
                     # device-major per harvest event, so appending here
@@ -465,11 +464,9 @@ class SpilledShardedEngine(ShardedEngine):
                     max_depth, max_states, verbose)
                 if fused:
                     burst_ok = not bailed
-                    # fire if ANY multiple of checkpoint_every was
-                    # crossed by the burst's multi-level depth jump
-                    every = max(1, checkpoint_every)
                     if checkpoint_path is not None and \
-                            depth // every > d0 // every:
+                            driver.ckpt_due_after_burst(
+                                depth, d0, checkpoint_every):
                         self._save_spill_mesh_checkpoint(
                             checkpoint_path, carry, res, frontier,
                             frontier_keys, depth, n_states, n_vis)
@@ -563,11 +560,9 @@ class SpilledShardedEngine(ShardedEngine):
                             next_keys[d].append(fk_b)
             self._flush_level_parts()
             res.generated_states += level_gen
-            if level_new == 0 and level_gen == 0:
-                depth -= 1
-            else:
-                res.level_sizes.append(sum(
-                    int(g.shape[0]) for q in next_frontier
+            depth = driver.gate_level_depth(
+                res, depth, level_new, level_gen,
+                sum(int(g.shape[0]) for q in next_frontier
                     for _r, g in q))
             frontier = next_frontier
             frontier_keys = next_keys
@@ -577,7 +572,7 @@ class SpilledShardedEngine(ShardedEngine):
                 # everything archived)
                 carry, n_vis = self._reseed_shards(carry, frontier_keys)
             if checkpoint_path is not None and \
-                    depth % max(1, checkpoint_every) == 0:
+                    driver.ckpt_due_at_level(depth, checkpoint_every):
                 self._save_spill_mesh_checkpoint(
                     checkpoint_path, carry, res, frontier,
                     frontier_keys, depth, n_states, n_vis)
@@ -975,50 +970,57 @@ class SpilledShardedEngine(ShardedEngine):
             lane_h = np.asarray(bout["lane"])
             st_h = {k: np.asarray(v) for k, v in bout["st"].items()}
             inv_h = np.asarray(bout["inv"])     # [D, L_MAX, kbd, n_inv]
-        for li in range(nlev):
+        def _stats(li):
+            return (int(stats[:, li, 0].sum()),
+                    int(stats[:, li, 1].sum()),
+                    int(stats[:, li, 2].sum()),
+                    int(stats[:, li, 3].sum()),
+                    int(stats[:, li, 4].sum()))
+
+        def _arch(li, _n_lvl):
+            if not self.store_states:
+                return
             nl = stats[:, li, 0]
-            n_lvl = int(nl.sum())
-            n_genl = int(stats[:, li, 4].sum())
-            res.distinct_states += n_lvl
-            res.generated_states += n_genl
-            res.overflow_faults += int(stats[:, li, 2].sum())
-            res.violations_global += int(stats[:, li, 1].sum())
-            prefix = np.cumsum(nl) - nl
             for d in range(D):
                 if not nl[d]:
                     continue
-                if self.store_states:
-                    # archive part in gid order (device-major per
-                    # level — exactly harvest_blocks' order)
-                    self._cur_parts.append(dict(
-                        n=int(nl[d]),
-                        lpar=par_h[d, li, :nl[d]].copy(),
-                        llane=lane_h[d, li, :nl[d]].copy(),
-                        rows_major={k: st_h[k][d, li, :nl[d]].copy()
-                                    for k in st_h}))
-                if stats[d, li, 1]:
-                    inv_ok = inv_h[d, li, :nl[d]]
-                    for s, j in zip(*np.nonzero(~inv_ok)):
-                        vsv, vh = self.ir.decode(lay, {
-                            k: np.asarray(st_h[k][d, li, s])
-                            for k in st_h})
-                        res.violations.append(Violation(
-                            self.inv_names[j],
-                            n_states + int(prefix[d]) + int(s),
-                            state=vsv, hist=vh))
-            self._flush_level_parts()
-            if n_lvl or n_genl:
-                depth += 1
-                # inside the depth gate (as engine/bfs) so
-                # levels_fused ≡ depth advanced everywhere
-                res.levels_fused += 1
-                res.level_sizes.append(int(stats[:, li, 3].sum()))
-            n_states += n_lvl
+                # archive part in gid order (device-major per level —
+                # exactly harvest_blocks' order)
+                self._cur_parts.append(dict(
+                    n=int(nl[d]),
+                    lpar=par_h[d, li, :nl[d]].copy(),
+                    llane=lane_h[d, li, :nl[d]].copy(),
+                    rows_major={k: st_h[k][d, li, :nl[d]].copy()
+                                for k in st_h}))
+
+        def _viol(li, _n_lvl, gid_base):
+            nl = stats[:, li, 0]
+            prefix = np.cumsum(nl) - nl
             for d in range(D):
-                n_vis[d] += nl[d]
+                if not nl[d] or not stats[d, li, 1]:
+                    continue
+                inv_ok = inv_h[d, li, :nl[d]]
+                for s, j in zip(*np.nonzero(~inv_ok)):
+                    vsv, vh = self.ir.decode(lay, {
+                        k: np.asarray(st_h[k][d, li, s])
+                        for k in st_h})
+                    res.violations.append(Violation(
+                        self.inv_names[j],
+                        gid_base + int(prefix[d]) + int(s),
+                        state=vsv, hist=vh))
+
+        def _vis(li, _n_lvl):
+            # the per-level part flush rides the shared loop's
+            # post-level hook (it moves archive parts only — counters
+            # never read it)
+            self._flush_level_parts()
+            for d in range(D):
+                n_vis[d] += stats[d, li, 0]
+
+        depth, n_states = driver.harvest_fused_levels(
+            res, nlev, _stats, depth, n_states, archive=_arch,
+            violations=_viol, visited=_vis)
         _hv_span.__exit__(None, None, None)
-        if n_states >= 2 ** 31 - 1:
-            raise RuntimeError("state-id space exhausted (2^31 ids)")
         # rebuild the per-device host frontier from the device shards
         # (pruned rows drop here — prune-not-expand stays host-side
         # outside the burst)

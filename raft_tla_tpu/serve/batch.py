@@ -756,10 +756,12 @@ class BucketEngine:
                 break
 
     def _harvest(self, run: _JobRun, sj, par_j, lane_j, inv_j, st_j):
-        """One job's slice of a batched call — the solo burst harvest,
-        verbatim semantics (depth gating, pseudo-level skip, archive
-        rows, violation decode)."""
-        from ..engine.bfs import Violation
+        """One job's slice of a batched call — the solo burst harvest
+        (the SHARED engine/driver core, so the serve copy can never
+        drift from the engine drivers again; depth gating, pseudo-level
+        skip, archive rows, violation decode all run in
+        driver.harvest_fused_levels)."""
+        from ..engine import driver
         eng = self.eng
         res = run.res
         nlev = int(sj[-1, 0])
@@ -773,44 +775,28 @@ class BucketEngine:
             run.mark_fallback("burst bailed (per-job ring or table "
                               "overflow) — re-run sequentially")
             return
-        for li in range(nlev):
-            n_lvl, n_viol, faults, n_expand, n_genl = (
-                int(x) for x in sj[li, :5])
-            res.distinct_states += n_lvl
-            res.generated_states += n_genl
-            res.overflow_faults += faults
-            res.violations_global += n_viol
-            if run.job.store_states and n_lvl:
-                run.parents.append(par_j[li, :n_lvl].copy())
-                run.lanes.append(lane_j[li, :n_lvl].copy())
-                run.states.append(
-                    {k: np.moveaxis(v[..., li, :n_lvl], -1, 0).copy()
-                     for k, v in st_j.items()})
-            elif run.job.store_states:
-                # zero-row levels still occupy an archive slot so gid
-                # arithmetic matches the solo archives
-                run.parents.append(np.zeros((0,), np.int32))
-                run.lanes.append(np.zeros((0,), np.int32))
-                run.states.append(
-                    {k: np.moveaxis(v[..., li, :0], -1, 0).copy()
-                     for k, v in st_j.items()})
-            if n_viol:
-                rows = {k: np.moveaxis(v[..., li, :n_lvl], -1, 0)
-                        for k, v in st_j.items()}
-                for jx, nm in enumerate(eng.inv_names):
-                    for s in np.nonzero(~inv_j[jx, li, :n_lvl])[0]:
-                        vsv, vh = eng.ir.decode(eng.lay,
-                                                _take(rows, int(s)))
-                        res.violations.append(
-                            Violation(nm, run.n_states + int(s),
-                                      state=vsv, hist=vh))
-            if n_lvl == 0 and n_genl == 0:
-                pass        # all-pruned pseudo-level: not a BFS level
-            else:
-                run.depth += 1
-                res.levels_fused += 1
-                res.level_sizes.append(n_expand)
-            run.n_states += n_lvl
+
+        def _arch(li, n_lvl):
+            if not run.job.store_states:
+                return
+            # zero-row levels still occupy an archive slot so gid
+            # arithmetic matches the solo archives
+            par, lane, states = driver.burst_archive_slice(
+                par_j, lane_j, st_j, li, n_lvl)
+            run.parents.append(par)
+            run.lanes.append(lane)
+            run.states.append(states)
+
+        def _viol(li, n_lvl, gid_base):
+            driver.burst_decode_violations(
+                res, eng.ir, eng.lay, eng.inv_names, inv_j, st_j,
+                li, n_lvl, gid_base)
+
+        # no id guard: per-job ids never approach 2^31 (the historical
+        # serve harvest carried none — bit-exact re-homing)
+        run.depth, run.n_states = driver.harvest_fused_levels(
+            res, nlev, lambda li: sj[li, :5], run.depth, run.n_states,
+            archive=_arch, violations=_viol, id_guard=False)
         run.n_front = int(sj[-1, 2])
         if run.n_front == 0 or run.depth >= run.job.max_depth or \
                 res.distinct_states >= run.job.max_states or \

@@ -122,8 +122,23 @@ class ExecCache:
     contract: a non-serializable backend reads as a labeled miss, not
     a crash or a silent wrong result)."""
 
-    def __init__(self, path: str, serializer=None):
+    def __init__(self, path: str, serializer=None,
+                 max_bytes: Optional[int] = None):
+        if max_bytes is not None and int(max_bytes) <= 0:
+            raise ValueError(
+                f"executable-cache max_bytes must be positive (got "
+                f"{max_bytes}); omit it for an unbounded cache")
         self.path = path
+        # LRU-by-bytes eviction bound (mirrors serve/cache.ResultCache,
+        # the ROADMAP item-1 leftover: bucket executables are MBs each
+        # on TPU, so a long-lived service needs a directory bound).
+        # Recency = file mtime, refreshed on every warm LOAD, so a hot
+        # bucket survives cold ones regardless of insertion order; the
+        # just-stored entry is never the victim (one oversized
+        # executable may transiently exceed the bound — the next store
+        # retires it like any other cold entry).  None = the historical
+        # unbounded behavior, exactly.
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
         os.makedirs(path, exist_ok=True)
         self._ser = serializer if serializer is not None \
             else JaxExecSerializer()
@@ -131,6 +146,7 @@ class ExecCache:
         self.misses = 0
         self.stores = 0
         self.store_failures = 0
+        self.evictions = 0
         # the most recent miss/store-failure reasons, newest last
         # (bounded: telemetry, not a log)
         self.miss_reasons = []
@@ -149,10 +165,54 @@ class ExecCache:
             "exec_cache_misses": self.misses,
             "exec_cache_stores": self.stores,
             "exec_cache_store_failures": self.store_failures,
+            "exec_cache_evictions": self.evictions,
             "exec_cache_miss_reasons": list(self.miss_reasons),
             "exec_cache_store_fail_reasons":
                 list(self.store_fail_reasons),
         }
+
+    def _touch(self, key: str):
+        """LRU recency refresh on a warm load — bounded caches only
+        (unbounded reads stay write-free, the historical behavior)."""
+        if self.max_bytes is None:
+            return
+        try:
+            os.utime(self._entry_path(key))
+        except OSError:
+            pass
+
+    def _evict(self, keep: str):
+        """Trim the directory back under max_bytes, least-recently-
+        used (oldest mtime) first, never touching the just-written
+        ``keep`` entry.  A racing deletion reads as already-evicted,
+        never an error."""
+        if self.max_bytes is None:
+            return
+        entries = []
+        total = 0
+        for nm in os.listdir(self.path):
+            if not nm.endswith(".exec"):
+                continue
+            fp = os.path.join(self.path, nm)
+            try:
+                st = os.stat(fp)
+            except OSError:
+                continue
+            total += st.st_size
+            entries.append((st.st_mtime, st.st_size, nm))
+        if total <= self.max_bytes:
+            return
+        for _mtime, size, nm in sorted(entries):
+            if nm == keep + ".exec":
+                continue
+            try:
+                os.remove(os.path.join(self.path, nm))
+            except OSError:
+                continue
+            self.evictions += 1
+            total -= size
+            if total <= self.max_bytes:
+                break
 
     def _entry_path(self, key: str) -> str:
         return os.path.join(self.path, key + ".exec")
@@ -196,6 +256,7 @@ class ExecCache:
                 f"backend cannot deserialize executables "
                 f"({type(e).__name__}: {str(e)[:120]})")
         self.hits += 1
+        self._touch(key)
         return ex, "hit"
 
     def store(self, key: str, compiled, parts: Optional[Dict] = None
@@ -229,4 +290,5 @@ class ExecCache:
                 f"cache dir unwritable ({e})"])[-8:]
             return False
         self.stores += 1
+        self._evict(keep=key)
         return True

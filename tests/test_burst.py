@@ -119,6 +119,9 @@ def test_burst_checkpoint_resume(tmp_path):
     assert resumed.level_sizes == full.level_sizes
 
 
+@pytest.mark.slow  # tier-1 budget (round 14): ~18s; violation +
+# stop_on_violation parity under the (batched) burst core stays fast
+# via test_serve::test_batched_violation_states_and_witness_parity.
 def test_burst_finds_violation():
     # a scenario property (negated reachability — FirstBecomeLeader
     # fires at the first leader election, a shallow burst-path level)

@@ -220,6 +220,9 @@ def test_paxos_engine_delta_on_off_full_space():
     assert r_on.delta_matmul == 1 and r_off.delta_matmul == 0
 
 
+@pytest.mark.slow  # tier-1 budget (round 14): ~38s; batched-serve
+# runs with delta ON (the default) in every fast test_serve rep, and
+# tools/delta_smoke.py pins CLI ON≡OFF counts each CI run.
 def test_serve_batch_delta_wave_matches_sequential():
     """A batched `cli batch` wave with delta ON (the default) is
     bit-exact per job vs the sequential reference — the job-vmapped
@@ -398,3 +401,98 @@ def test_paxos_multi_instance_delta_on_off():
     r_off = Engine(pc, chunk=128, store_states=False,
                    delta_matmul=False).check(max_depth=8)
     assert _key(r_on) == _key(r_off)
+
+
+# ---------------------------------------------------------------------
+# chunk skip (round 14, the ROADMAP item-3 leftover): the delta group
+# applies as per-family lax.cond blocks, skipping a family's whole
+# cap-wide slice when the chunk enables none of its lanes.  Default
+# follows the MXU lowering (ON on TPU, OFF on CPU); forced ON here to
+# pin both cond branches bit-exact against the kernel path.
+# ---------------------------------------------------------------------
+
+
+def _materialize_skip(cfg, svT):
+    """cand under delta_chunk_skip=True on a real guard mask, plus the
+    kernel-path reference and per-family enabled counts."""
+    ex_skip = Expander(cfg, delta_matmul=True, delta_chunk_skip=True)
+    ex_off = Expander(cfg, delta_matmul=False)
+    assert ex_skip.delta_chunk_skip and not ex_off.delta_chunk_skip
+    derT = ex_skip.derived_batch_T(svT)
+    ok = np.asarray(ex_skip.guards_T(svT, derT))
+    B = ok.shape[0]
+    okf = jnp.asarray(ok.reshape(-1))
+    FCAP = int(ok.sum()) + 8
+    epos = jnp.where(okf, jnp.cumsum(okf.astype(jnp.int32)) - 1, FCAP)
+    caps = ex_skip.default_fam_caps(B)
+    c_skip, f_skip = jax.jit(lambda s, d: ex_skip.materialize(
+        s, d, okf, epos, FCAP, caps))(svT, derT)
+    c_off, f_off = jax.jit(lambda s, d: ex_off.materialize(
+        s, d, okf, epos, FCAP, caps))(svT, derT)
+    famx = {ex_skip.families[fi].name: int(np.asarray(f_skip)[fi])
+            for fi in ex_skip._dgroup["fam_idx"]}
+    return c_skip, c_off, f_skip, f_off, int(ok.sum()), famx
+
+
+@pytest.mark.slow
+def test_delta_chunk_skip_equals_kernel_path():
+    """Reachable-state batch: some affine families enable lanes (apply
+    branch), others none (skip branch — early BFS states have no
+    leader, so BecomeLeader/ClientRequest sit disabled) — columns
+    bit-equal to the kernel path either way.  (The fast/smoke rep is
+    the root-chunk test below; the engine-scale pair is slow too.)"""
+    svT = _reachable_svT(DYN, n=120)
+    c_skip, c_off, f_skip, f_off, n_e, famx = _materialize_skip(
+        DYN, svT)
+    np.testing.assert_array_equal(np.asarray(f_skip),
+                                  np.asarray(f_off))
+    assert any(v > 0 for v in famx.values()), famx
+    assert any(v == 0 for v in famx.values()), famx
+    for k in c_skip:
+        np.testing.assert_array_equal(
+            np.asarray(c_skip[k])[..., :n_e],
+            np.asarray(c_off[k])[..., :n_e], err_msg=k)
+
+
+@pytest.mark.smoke
+def test_delta_chunk_skip_all_disabled_families():
+    """Root-only chunk: several affine families enable NO lanes, so
+    their conds take the SKIP branch — enabled successors still
+    bit-equal the kernel path (the skipped slices were compaction
+    garbage no consumer reads)."""
+    ir = get_spec("raft")
+    lay = ir.make_layout(DYN)
+    row = ir.widen(ir.encode(lay, *ir.init_state(DYN)))
+    svT = {k: jnp.moveaxis(jnp.asarray(np.stack([np.asarray(v)] * 4)),
+                           0, -1) for k, v in row.items()}
+    c_skip, c_off, f_skip, f_off, n_e, famx = _materialize_skip(
+        DYN, svT)
+    np.testing.assert_array_equal(np.asarray(f_skip),
+                                  np.asarray(f_off))
+    # the init chunk really drives the skip branch: Timeout fires,
+    # the message-dependent affine families (Duplicate/Drop) cannot
+    assert famx["Timeout"] > 0 and famx["Duplicate"] == 0 \
+        and famx["Drop"] == 0, famx
+    assert n_e > 0
+    for k in c_skip:
+        np.testing.assert_array_equal(
+            np.asarray(c_skip[k])[..., :n_e],
+            np.asarray(c_off[k])[..., :n_e], err_msg=k)
+
+
+@pytest.mark.slow
+def test_engine_delta_chunk_skip_full_space():
+    """End-to-end: a chunk-skip engine reproduces the default engine's
+    counts, archives and gids over the full TINY space (per-level
+    chunks routinely enable only a subset of families — both cond
+    branches exercised at engine scale)."""
+    e_skip = Engine(TINY, chunk=64, store_states=True,
+                    delta_chunk_skip=True)
+    r_skip = e_skip.check()
+    e_def = Engine(TINY, chunk=64, store_states=True)
+    r_def = e_def.check()
+    assert _key(r_skip) == _key(r_def)
+    for pa, pb in zip(e_skip._parents, e_def._parents):
+        np.testing.assert_array_equal(pa, pb)
+    for la, lb in zip(e_skip._lanes, e_def._lanes):
+        np.testing.assert_array_equal(la, lb)

@@ -115,6 +115,28 @@ def test_multihost_violation_trace(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_store_states_with_checkpoint(tmp_path):
+    """store_states × checkpointing WORKS (round 14 — previously a
+    documented exclusion): every controller's checkpoint shard carries
+    its own archive rows + device segmentation, so a resumed run keeps
+    appending and the final merged witness trace is bit-identical to
+    an uninterrupted run's."""
+    ref = _run_pair({"trace_dir": str(tmp_path / "arch_ref"),
+                     "trace_gid": 100, "max_depth": 9})
+    ckpt = str(tmp_path / "mh.ckpt")
+    _run_pair({"checkpoint": ckpt, "max_depth": 6,
+               "trace_dir": str(tmp_path / "arch_part")})
+    assert os.path.exists(ckpt + ".proc0")
+    full = _run_pair({"resume": ckpt, "max_depth": 9,
+                      "trace_dir": str(tmp_path / "arch_res"),
+                      "trace_gid": 100})
+    for r in full:
+        assert r["distinct"] == ref[0]["distinct"]
+        assert r["depth"] == ref[0]["depth"]
+        assert r["traces"][0] == ref[0]["traces"][0]
+
+
+@pytest.mark.slow
 def test_multihost_midrun_growth():
     """Tiny send/level caps force mid-run capacity growth; every
     controller takes the identical growth branch (replicated scal) and

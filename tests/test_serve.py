@@ -52,6 +52,11 @@ def _trace_key(trace):
     return [(label, repr(sv)) for label, sv in trace]
 
 
+@pytest.mark.slow  # tier-1 budget (round 14): ~43s; batched ≡ solo
+# parity (counts, violation ids, witness traces) stays fast via
+# test_batched_violation_states_and_witness_parity, and
+# tools/serve_smoke.py batches a mixed raft+paxos wave through the
+# real CLI every CI run.
 def test_batched_mixed_specs_bit_exact():
     """The tier-1 representative: a mixed raft+paxos job list through
     the batched path lands bit-exact against per-job sequential
@@ -271,6 +276,9 @@ def test_batch_obs_ledger_rows_and_heartbeat(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 budget (round 14): ~50s; the paxos hetero
+# rep below stays fast and tools/serve_smoke.py runs a 4-distinct-
+# bounds raft hetero wave on the real CLI every CI run.
 def test_heterogeneous_raft_bounds_one_bucket_bit_exact():
     """Two raft jobs with DIFFERENT search bounds (so their reachable
     sets genuinely differ at the test depth) land in ONE padded bucket
@@ -421,6 +429,72 @@ def test_exec_cache_roundtrip_corrupt_and_foreign_miss(tmp_path):
     stats = cache.stats()
     assert stats["exec_cache_hits"] == 1
     assert stats["exec_cache_misses"] >= 3
+
+
+@pytest.mark.smoke
+def test_exec_cache_lru_bytes_eviction(tmp_path):
+    """LRU-by-bytes bound (round 14 — the eviction half ROADMAP item 1
+    left open, mirroring serve/cache.ResultCache): every store trims
+    the directory back under max_bytes, oldest-mtime first; a warm
+    LOAD refreshes recency so a hot bucket survives; the just-written
+    entry is never the victim; None keeps the historical unbounded
+    behavior."""
+    import time as _t
+
+    def entry_bytes(key):
+        cache = ExecCache(str(tmp_path), serializer=_FakeSerializer())
+        cache.store(key, object())
+        return os.path.getsize(tmp_path / f"{key}.exec")
+
+    one = entry_bytes("probe")
+    os.remove(tmp_path / "probe.exec")
+    with pytest.raises(ValueError, match="must be positive"):
+        ExecCache(str(tmp_path), max_bytes=0)
+    cache = ExecCache(str(tmp_path), serializer=_FakeSerializer(),
+                      max_bytes=int(2.5 * one))
+    assert cache.store("a", object())
+    _t.sleep(0.05)
+    assert cache.store("b", object())
+    _t.sleep(0.05)
+    # a warm load refreshes "a"'s mtime: it becomes the NEWEST
+    ex, why = cache.load("a")
+    assert why == "hit"
+    _t.sleep(0.05)
+    # third entry overflows the bound: the LRU victim is now "b"
+    assert cache.store("c", object())
+    assert cache.evictions == 1
+    assert sorted(p.name for p in tmp_path.glob("*.exec")) == \
+        ["a.exec", "c.exec"]
+    # the just-written entry is never the victim, even when a single
+    # oversized store exceeds the bound on its own
+    tiny = ExecCache(str(tmp_path / "tiny"),
+                     serializer=_FakeSerializer(), max_bytes=1)
+    assert tiny.store("big", object())
+    assert os.path.exists(tmp_path / "tiny" / "big.exec")
+    assert tiny.evictions == 0
+    # ... and the NEXT store retires it like any other cold entry
+    assert tiny.store("big2", object())
+    assert not os.path.exists(tmp_path / "tiny" / "big.exec")
+    # unbounded default: no eviction ever, loads stay write-free
+    unb = ExecCache(str(tmp_path / "unb"),
+                    serializer=_FakeSerializer())
+    for i in range(4):
+        unb.store(f"k{i}", object())
+    assert unb.evictions == 0
+    assert len(list((tmp_path / "unb").glob("*.exec"))) == 4
+    assert unb.stats()["exec_cache_evictions"] == 0
+
+
+def test_exec_cache_max_bytes_cli_validation():
+    """batch --executable-cache-max-bytes is a usage error (exit 2,
+    named message) without --executable-cache or with a non-positive
+    bound — never a traceback."""
+    from raft_tla_tpu.cli import main
+    assert main(["batch", "--job", '{"spec": "paxos"}',
+                 "--executable-cache-max-bytes", "100"]) == 2
+    assert main(["batch", "--job", '{"spec": "paxos"}',
+                 "--executable-cache", "/tmp/nope",
+                 "--executable-cache-max-bytes", "-5"]) == 2
 
 
 def test_exec_cache_warm_restart_zero_compiles_and_slo_obs(tmp_path):

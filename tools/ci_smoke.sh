@@ -33,3 +33,7 @@ env JAX_PLATFORMS=cpu python tools/serve_smoke.py
 # mid-wave via the deterministic wave_kill chaos site, re-invoke, and
 # assert bit-exact completion with a ledger showing the wave resume
 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+# pod-scale pjit gate (round 14): depth-capped `check --pjit`
+# (whole-state named shardings) ≡ the default engine, reference-less
+# CLI A/B count parity
+env JAX_PLATFORMS=cpu python tools/pjit_smoke.py

@@ -6,7 +6,10 @@ opts (all optional): {"checkpoint": path, "resume": path,
                       "max_depth": int, "lcap": int, "vcap": int,
                       "scap": int, "chunk_mult": int,
                       "invariants": [names], "trace_dir": path,
-                      "stop_on_violation": bool}
+                      "trace_gid": int, "stop_on_violation": bool}
+trace_gid replays one witness chain from the merged archives at run
+end (the store_states × checkpoint differential reads it on a resumed
+run).
 trace_dir enables store_states: each controller writes its archive
 shard and the violation-finding controller replays the full witness
 trace across the merged per-controller files (multihost_engine).
@@ -59,6 +62,8 @@ r = eng.check(max_depth=opts.get("max_depth", 10 ** 9),
               resume_from=opts.get("resume"),
               stop_on_violation=opts.get("stop_on_violation", False))
 traces = []
+if trace_dir and opts.get("trace_gid") is not None:
+    traces.append([lbl for lbl, _ in eng.trace(int(opts["trace_gid"]))])
 if trace_dir and r.violations:
     # mesh-scale witness reconstruction: the controller that holds the
     # violating shard replays the parent chain across every

@@ -1,0 +1,64 @@
+"""Pjit-engine CI smoke (tools/ci_smoke.sh step, round 14).
+
+A depth-capped CLI ``check --pjit`` (the whole BFS state under named
+shardings — parallel/pjit_mesh) must land on IDENTICAL counts to the
+default single-device engine: same program, different partitioning, so
+this is reference-less A/B parity, no oracle.  Exercises the
+end-to-end flag wiring (CLI → PjitShardedEngine) on whatever devices
+the container has (CPU: jax's host platform; the mesh is however many
+devices XLA exposes — 1 is a valid degenerate mesh and still runs the
+pjit program).
+
+Sub-minute on CPU; the 8-virtual-device and 2-controller reps live in
+tests/test_pjit.py.  Exits 0 on identity, 1 with a message.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPEC = [os.path.join(_REPO, "configs", "tlc_membership", "raft.cfg"),
+        "--servers", "2", "--init-servers", "2",
+        "--max-log-length", "1", "--max-timeouts", "1",
+        "--max-client-requests", "1", "--max-depth", "6"]
+
+
+def fail(msg):
+    print(f"pjit_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_one(extra, stats_path):
+    cmd = [sys.executable, "-m", "raft_tla_tpu", "check"] + SPEC + \
+        extra + ["--stats-json", stats_path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, env=env, cwd=_REPO,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        fail(f"check {' '.join(extra)} failed rc={proc.returncode}:\n"
+             f"{proc.stderr}")
+    with open(stats_path) as fh:
+        return json.load(fh)
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="pjit_smoke_") as td:
+        ref = run_one([], os.path.join(td, "ref.json"))
+        pj = run_one(["--pjit"], os.path.join(td, "pjit.json"))
+        for key in ("distinct_states", "generated_states", "depth",
+                    "dedup_hit_rate", "violations"):
+            if ref[key] != pj[key]:
+                fail(f"{key}: pjit {pj[key]} != default engine "
+                     f"{ref[key]} — the sharded program diverged")
+        print(f"pjit_smoke: --pjit ≡ default at depth {pj['depth']} "
+              f"({pj['distinct_states']} states)")
+    print("pjit_smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

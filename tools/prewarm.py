@@ -83,6 +83,25 @@ def warm_spill(tag, cfg, **kw):
     del eng
 
 
+def warm_pjit(tag, cfg, **kw):
+    """Pjit-engine warm (round 14): the whole-state-sharded program's
+    step/finalize/burst executables trace with NamedSharding
+    out_shardings, so they are DISTINCT cache entries from the classic
+    engine's — one depth-2 check per burst mode lands them (plus the
+    sharded fresh-carry builders) in the persistent cache before a
+    pod-scale session pays them cold."""
+    from raft_tla_tpu.parallel.pjit_mesh import PjitShardedEngine
+    t0 = time.time()
+    for burst in (True, False):
+        eng = PjitShardedEngine(cfg, store_states=False, burst=burst,
+                                **kw)
+        eng.check(max_depth=2)
+    print(f"{tag}: pjit warmed in {time.time() - t0:.1f}s "
+          f"(D={eng.D} chunk={eng.chunk} LCAP={eng.LCAP} "
+          f"VCAP={eng.VCAP})", flush=True)
+    del eng
+
+
 def warm_resume(tag, cfg, **kw):
     """Resume-repartition warm (round 12): checkpoint a depth-2 run,
     load the portable image and resume it on the spill engine — this
@@ -142,6 +161,9 @@ def main():
         warm("bench micro gate", micro, chunk=256)
         # the supervised-recovery path's executables (round 12)
         warm_resume("resume repartition", micro, chunk=256)
+        # the pod-scale sharded program (round 14) — its executables
+        # are distinct cache entries from the classic engine's
+        warm_pjit("pjit micro", micro, chunk=256)
         warm("bench headline", build_cfg(2), chunk=2048,
              lcap=bench.LCAP, vcap=bench.VCAP)
         # deep_run's spill probe shape, host table OFF and ON: the ON
