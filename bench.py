@@ -803,6 +803,150 @@ def _pjit_ab(out_path):
     return out
 
 
+def _canon_ab(out_path):
+    """Orbit-sort canonicalization A/B (BENCH round 14 file, repo
+    round 15): the config-#5 SHAPE — S=5 all-init, full S_5 symmetry,
+    P=120 — checked depth-capped with ``--sym-canon sort`` (ONE
+    argsorted canonical relabeling hashed per state, adjacent-
+    transposition certificates, rare min-over-perms fallback) vs
+    ``minperm`` (the historical P-fold min).  Counts must be
+    bit-identical — the orbit partitions are provably equal, so any
+    divergence is a miscompile and the file is FAILED.
+
+    On top of the end-to-end rows, a STANDALONE fingerprint-phase
+    micro-pair times the replaced primitive directly (the engine fuses
+    hashing inside one jit, so per-phase wall-clock needs standalone
+    dispatch): ``canon_sort`` vs ``canon_minperm`` — jitted
+    ``fingerprint_batch_T`` over the same reachable 256-state batch.
+    The partition induced by the two modes' values must be identical
+    (the VALUES themselves differ by design: the sort hash is salted
+    into a disjoint codomain so cross-mode tables can never alias).
+    At P=120 the sort path does ~1 hash + 1 argsort + S-1 certificate
+    probes where minperm does 120 masked hashes; the round claims
+    >=3x on this phase and the file records whether the claim held.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+    from raft_tla_tpu.engine.bfs import Engine
+    from raft_tla_tpu.engine.fingerprint import Fingerprinter
+    from raft_tla_tpu.models.explore import explore
+    from raft_tla_tpu.obs import Obs, SpanRecorder
+    from raft_tla_tpu.ops.codec import encode, widen
+    from raft_tla_tpu.ops.layout import Layout
+
+    cfg5 = ModelConfig(
+        n_servers=5, init_servers=(0, 1, 2, 3, 4), values=(1,),
+        next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+        bounds=Bounds.make(max_log_length=2, max_timeouts=1,
+                           max_client_requests=1))
+    DEPTH = 4
+    rows, counts = {}, {}
+    for label, mode in (("minperm", "minperm"), ("sort", "sort")):
+        eng = Engine(cfg5, chunk=256, store_states=False,
+                     sym_canon=mode)
+        rec = SpanRecorder()
+        obs = Obs(spans=rec)
+        with obs.span("compile"):
+            eng.check(max_depth=2)               # warm the jit caches
+        t0 = time.perf_counter()
+        r = eng.check(max_depth=DEPTH, obs=obs)
+        secs = time.perf_counter() - t0
+        rows[label] = {
+            "distinct_states": int(r.distinct_states),
+            "depth": int(r.depth),
+            "sym_canon": int(r.sym_canon),
+            "seconds": round(secs, 2),
+            "states_per_sec": round(
+                r.distinct_states / max(secs, 1e-9), 1),
+            "phase_seconds": {nm: t["seconds"]
+                              for nm, t in rec.totals().items()},
+        }
+        counts[label] = (r.distinct_states, r.generated_states,
+                         r.depth, tuple(r.level_sizes))
+    identical = counts["sort"] == counts["minperm"]
+    flags_ok = (rows["sort"]["sym_canon"] == 1 and
+                rows["minperm"]["sym_canon"] == 0)
+
+    # ---- standalone fingerprint-phase micro-pair ---------------------
+    lay = Layout(cfg5)
+    st = list(explore(cfg5, max_states=2048,
+                      keep_states=True).states.values())[:512]
+    batch = widen({k: np.stack([encode(lay, sv, h)[k]
+                                for sv, h in st])
+                   for k in encode(lay, *st[0])})
+    svT = {k: jnp.moveaxis(jnp.asarray(v), 0, -1)
+           for k, v in batch.items()}
+    fprs = {m: Fingerprinter(cfg5, sym_canon=m)
+            for m in ("sort", "minperm")}
+    fns = {m: jax.jit(f.fingerprint_batch_T) for m, f in fprs.items()}
+    fp = {m: np.asarray(fn(svT)) for m, fn in fns.items()}   # warm
+
+    def gids(a):
+        """[n_streams, B] values -> first-occurrence group ids: the
+        induced partition, comparable across disjoint codomains."""
+        seen = {}
+        return [seen.setdefault(tuple(int(a[t, b])
+                                      for t in range(a.shape[0])), b)
+                for b in range(a.shape[1])]
+
+    partition_identical = gids(fp["sort"]) == gids(fp["minperm"])
+    hard = fprs["sort"].sort_debug(batch)["hard"]
+    rec2 = SpanRecorder()
+    REPS = 20
+    phase_secs = {}
+    for m in ("sort", "minperm"):
+        with rec2.span(f"canon_{m}"):
+            for _ in range(REPS):
+                fns[m](svT)[0].block_until_ready()
+        phase_secs[m] = rec2.totals()[f"canon_{m}"]["seconds"]
+    speedup = phase_secs["minperm"] / max(phase_secs["sort"], 1e-9)
+    speedup_3x = speedup >= 3.0
+
+    plat = jax.default_backend()
+    ok = identical and flags_ok and partition_identical and speedup_3x
+    out = {
+        "bench": "orbit-sort canonicalization A/B: one argsorted "
+                 "canonical hash vs the P=120 min-over-perms "
+                 "(bench.py, BENCH_r14 round)",
+        "platform": plat,
+        "honest_label": (
+            "CPU-only fallback: this container has no TPU — the "
+            "count/partition identities are platform-independent; the "
+            "canon_sort seconds time XLA:CPU's argsort+gather "
+            "lowering, NOT the TPU sort/gather units, so the phase "
+            "ratio is the fallback's, measured against the same "
+            "fallback's 120 masked hashes"
+            if plat == "cpu" else "TPU-measured"),
+        "status": ("ok" if ok else
+                   "FAILED: sort-mode counts/partition diverge from "
+                   "min-over-perms (or the claimed fingerprint-phase "
+                   "speedup did not hold) — the perf rows are "
+                   "meaningless"),
+        "counts_identical": identical,
+        "mode_flags_stamped": flags_ok,
+        "partition_identical": partition_identical,
+        "perm_group_size": len(fprs["minperm"].sigmas),
+        "hard_fallback_rate": round(float(np.mean(hard)), 4),
+        "fingerprint_phase_seconds": {
+            m: round(s, 4) for m, s in phase_secs.items()},
+        "fingerprint_phase_speedup": round(speedup, 2),
+        "speedup_at_least_3x": speedup_3x,
+        "fingerprint_phase_note": (
+            f"canon_sort/canon_minperm: {REPS} jitted "
+            "fingerprint_batch_T dispatches each over the same "
+            "512-state reachable batch at S=5, P=120"),
+        "rows": rows,
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, out_path)
+    return out
+
+
 def _no_reference_fallback():
     """Containers without the reference checkout (and without the TPU)
     cannot run the headline metric at all — emit ONE honestly-labeled
@@ -884,6 +1028,10 @@ def _no_reference_fallback():
     pjit_ab = _pjit_ab(os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "BENCH_r13.json"))
     gate_ok = gate_ok and pjit_ab["status"] == "ok"
+    # round 14 file (PR 15): orbit-sort canonicalization, same gate
+    canon_ab = _canon_ab(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r14.json"))
+    gate_ok = gate_ok and canon_ab["status"] == "ok"
     print(json.dumps({
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
         "value": None, "unit": "states/sec", "vs_baseline": None,
@@ -930,7 +1078,14 @@ def _no_reference_fallback():
                        "status": pjit_ab["status"],
                        "overlap_visible": pjit_ab["overlap_visible"],
                        "pjit_vs_mesh_seconds":
-                           pjit_ab["pjit_vs_mesh_seconds"]}}}))
+                           pjit_ab["pjit_vs_mesh_seconds"]},
+                   "canon_ab": {
+                       "written_to": "BENCH_r14.json",
+                       "status": canon_ab["status"],
+                       "fingerprint_phase_speedup":
+                           canon_ab["fingerprint_phase_speedup"],
+                       "hard_fallback_rate":
+                           canon_ab["hard_fallback_rate"]}}}))
 
 
 def main():
@@ -1040,6 +1195,9 @@ def main():
     pjit_ab = _pjit_ab(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_r13.json"))
     gate_ok = gate_ok and pjit_ab["status"] == "ok"
+    canon_ab = _canon_ab(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r14.json"))
+    gate_ok = gate_ok and canon_ab["status"] == "ok"
 
     # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------
     # Only meaningful for the full-depth run on the recorded machine
@@ -1092,6 +1250,7 @@ def main():
     out["detail"]["delta_ab_status"] = delta_ab["status"]
     out["detail"]["ceiling_ab_status"] = ceiling_ab["status"]
     out["detail"]["pjit_ab_status"] = pjit_ab["status"]
+    out["detail"]["canon_ab_status"] = canon_ab["status"]
     print(json.dumps(out))
 
 

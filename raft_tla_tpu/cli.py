@@ -418,7 +418,8 @@ def cmd_check(args):
                         guard_matmul=args.guard_matmul,
                         dedup_kernel=args.dedup_kernel,
                         delta_matmul=args.delta_matmul,
-                        fam_density=fam_density)
+                        fam_density=fam_density,
+                        sym_canon=args.sym_canon)
 
         def make_engine():
             # one fresh engine per supervised attempt — the backend-
@@ -634,7 +635,8 @@ def cmd_trace(args):
     from .engine.bfs import Engine
     eng = Engine(cfg, chunk=args.chunk, store_states=True,
                  guard_matmul=args.guard_matmul,
-                 delta_matmul=args.delta_matmul)
+                 delta_matmul=args.delta_matmul,
+                 sym_canon=args.sym_canon)
     r = eng.check(max_depth=args.max_depth, max_states=args.max_states,
                   stop_on_violation=True, verbose=args.verbose)
     if not r.violations:
@@ -683,7 +685,8 @@ def cmd_simulate(args):
     kw = dict(max_depth=depth, seed=args.seed, policy=args.policy,
               bloom_bits=args.bloom_bits,
               guard_matmul=args.guard_matmul,
-              delta_matmul=args.delta_matmul)
+              delta_matmul=args.delta_matmul,
+              sym_canon=args.sym_canon)
     if args.mesh and len(jax.local_devices()) > 1:
         from .parallel.sim_mesh import ShardedSimEngine
         eng = ShardedSimEngine(cfg, walkers=args.walkers, **kw)
@@ -828,6 +831,10 @@ def cmd_batch(args):
                                verbose=args.verbose,
                                wave_state=args.wave_state,
                                wave_yield=args.wave_yield,
+                               bucket_overrides=(
+                                   {"sym_canon": args.sym_canon}
+                                   if args.sym_canon != "auto"
+                                   else None),
                                exec_cache=exec_cache)
                 done = True
                 break
@@ -939,6 +946,20 @@ def main(argv=None):
                              "declaration-less families keep the "
                              "per-family kernel path either way, and "
                              "--no-delta-matmul restores it for all")
+        sp.add_argument("--sym-canon",
+                        choices=("auto", "sort", "minperm"),
+                        default="auto",
+                        help="symmetry canonicalization (round 15): "
+                             "'sort' hashes ONE orbit-sorted canonical "
+                             "relabeling per state (equivariant "
+                             "signatures + argsort; signature ties "
+                             "fall back to min-over-residual-perms, "
+                             "so the state partition is IDENTICAL); "
+                             "'minperm' keeps the P-fold "
+                             "min-over-perms; 'auto' (default) picks "
+                             "sort past 6 perms.  Fingerprint VALUES "
+                             "are mode-specific — checkpoints refuse "
+                             "cross-mode resume")
         sp.add_argument("--verbose", "-v", action="store_true")
 
     pc = sub.add_parser("check", help="exhaustive bounded check")
@@ -1233,6 +1254,14 @@ def main(argv=None):
                          "chaos); 'wave_kill:at=1' is the "
                          "deterministic SIGKILL stand-in the CI "
                          "chaos smoke uses")
+    pb.add_argument("--sym-canon",
+                    choices=("auto", "sort", "minperm"),
+                    default="auto",
+                    help="symmetry canonicalization for every bucket "
+                         "engine and solo fallback (see check "
+                         "--sym-canon); part of the executable cache "
+                         "key — sort and minperm never share a "
+                         "compiled bucket")
     pb.add_argument("--stats-json", default=None, metavar="FILE",
                     help="write the batch summary + per-job reports "
                          "as one JSON file")

@@ -187,7 +187,8 @@ class CheckResult:
                  violations_global: int = 0, levels_fused: int = 0,
                  burst_dispatches: int = 0, burst_bailouts: int = 0,
                  pin_interior_states: int = 0, guard_matmul: int = 0,
-                 dedup_kernel: int = 0, delta_matmul: int = 0):
+                 dedup_kernel: int = 0, delta_matmul: int = 0,
+                 sym_canon: int = 0):
         from ..obs.metrics import MetricsRegistry
         init = locals()
         self.metrics = MetricsRegistry()
@@ -295,7 +296,7 @@ def ckpt_write(path, carry, store_states, parents, lanes, states, res,
 
 
 def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded, spill=False,
-              expected_format=None, spec_name=None):
+              expected_format=None, spec_name=None, sym_canon=None):
     """np.load + the meta validation every engine shares.  Returns
     (npz, meta) or raises CheckpointError.
 
@@ -308,6 +309,14 @@ def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded, spill=False,
     spec mismatch (same pattern as the config-mismatch refusal below;
     meta lacking the key reads as "raft" — every pre-IR checkpoint is
     a Raft one).
+
+    sym_canon — the resuming engine's RESOLVED canonicalization mode
+    ("sort" | "minperm"): the visited table stores fingerprint VALUES,
+    and orbit-sort values are a bijective remix of min-over-perms
+    values (fingerprint._core_sort), so resuming across modes would
+    silently re-visit every known state.  Refused by name; meta
+    lacking the key reads as "minperm" — every round-14 checkpoint
+    predates the sort path.
 
     Integrity (round 12, resil/ckpt_chain): the file's sha256 sidecar
     is verified BEFORE any array is touched — a truncated or corrupt
@@ -332,6 +341,15 @@ def ckpt_read(path, cfg_repr, chunk, extra_keys, sharded, spill=False,
                 f"{path}: checkpoint was written for spec "
                 f"{got_spec!r}; engine is running spec {spec_name!r} "
                 f"— resume with --spec {got_spec}")
+    if sym_canon is not None:
+        got_mode = meta.get("sym_canon", "minperm")
+        if got_mode != sym_canon:
+            raise CheckpointError(
+                f"{path}: checkpoint fingerprints were canonicalized "
+                f"with --sym-canon {got_mode}; engine resolved "
+                f"{sym_canon} — fingerprint values are mode-specific "
+                f"(the visited table would miss every known state) — "
+                f"resume with --sym-canon {got_mode}")
     # spill before sharded: a spill checkpoint handed to ShardedEngine
     # must name SpillEngine, not "the single-device Engine"
     if bool(meta.get("spill")) != spill:
@@ -450,7 +468,8 @@ class Engine:
                  dedup_kernel: str = "auto",
                  delta_matmul: bool = True,
                  delta_chunk_skip: Optional[bool] = None,
-                 fam_density: Optional[Dict[str, int]] = None):
+                 fam_density: Optional[Dict[str, int]] = None,
+                 sym_canon: str = "auto"):
         enable_persistent_compilation_cache()
         self.cfg = cfg
         # the active spec's compiled operator surface (SpecIR): layout,
@@ -513,7 +532,13 @@ class Engine:
             dedup_kernel == "on" or
             (dedup_kernel == "auto" and plat == "tpu"))
         self._dedup_interpret = plat != "tpu"
-        self.fpr = Fingerprinter(cfg)
+        # symmetry canonicalization mode (fingerprint.resolve_sym_canon):
+        # "sort" hashes ONE argsorted canonical relabeling per state,
+        # "minperm" keeps the historical P-fold min-over-perms; "auto"
+        # picks sort past 6 perms.  Fingerprint VALUES are mode-specific
+        # (checkpoints refuse cross-mode resume) but the induced state
+        # partition is identical — bench._canon_ab pins the A/B.
+        self.fpr = Fingerprinter(cfg, sym_canon=sym_canon)
         self.preds = self.ir.make_predicates(self.lay)
         self.inv_names = list(cfg.invariants)
         self.con_names = list(cfg.constraints)
@@ -1580,6 +1605,10 @@ class Engine:
         # 1 only when the delta program actually compiled (flag ON and
         # the spec declares at least one affine family)
         res.delta_matmul = int(self.expander.delta_active)
+        # 1 = orbit-sort canonical fingerprints, 0 = min-over-perms
+        # (fingerprint.resolve_sym_canon — the RESOLVED mode, so "auto"
+        # runs report what they actually executed)
+        res.sym_canon = int(self.fpr.sym_canon == "sort")
         return res
 
     def _prewarm_perlevel(self):
@@ -2161,6 +2190,7 @@ class Engine:
                            fam_caps=list(self.FAM_CAPS), **arch_meta,
                            layout=2, chunk=self.chunk,
                            spec=self.ir.name,
+                           sym_canon=self.fpr.sym_canon,
                            ir_fingerprint=self.ir.fingerprint(),
                            cfg=repr(self.cfg)),
                        keep=self.ckpt_keep)
@@ -2172,7 +2202,8 @@ class Engine:
                             sharded=False, expected_format=(
                                 "layout", 2, "this engine's batch-last/"
                                 "narrow-dtype storage layout"),
-                            spec_name=self.ir.name)
+                            spec_name=self.ir.name,
+                            sym_canon=self.fpr.sym_canon)
         self.LCAP, self.VCAP, self.FCAP, self.OCAP = (
             meta["LCAP"], meta["VCAP"], meta["FCAP"], meta["OCAP"])
         self.FAM_CAPS = tuple(int(c) for c in meta["fam_caps"])

@@ -103,7 +103,8 @@ class SpillEngine(Engine):
                  guard_matmul: bool = True,
                  dedup_kernel: str = "auto",
                  delta_matmul: bool = True,
-                 fam_density: Optional[Dict[str, int]] = None):
+                 fam_density: Optional[Dict[str, int]] = None,
+                 sym_canon: str = "auto"):
         # burst (fused multi-level dispatch) is ON by default since
         # round 8 — the tiny early levels of a deep spill run pay the
         # same tunneled dispatch floor as the classic engine's; pass
@@ -116,7 +117,8 @@ class SpillEngine(Engine):
                          guard_matmul=guard_matmul,
                          dedup_kernel=dedup_kernel,
                          delta_matmul=delta_matmul,
-                         fam_density=fam_density)
+                         fam_density=fam_density,
+                         sym_canon=sym_canon)
         self.SEGL = self.LCAP          # level segment rows (can grow)
         self.SEGF = self.LCAP          # frontier segment rows (fixed)
         self.sync_every = max(1, int(sync_every))
@@ -1375,6 +1377,7 @@ class SpillEngine(Engine):
                        partitions=self.partitions, **arch_meta,
                        layout=2, chunk=self.chunk,
                        spec=self.ir.name,
+                       sym_canon=self.fpr.sym_canon,
                        ir_fingerprint=self.ir.fingerprint(),
                        cfg=repr(self.cfg)),
                    keep=self.ckpt_keep)
@@ -1446,7 +1449,8 @@ class SpillEngine(Engine):
                             sharded=False, spill=True, expected_format=(
                                 "layout", 2, "this engine's batch-last/"
                                 "narrow-dtype storage layout"),
-                            spec_name=self.ir.name)
+                            spec_name=self.ir.name,
+                            sym_canon=self.fpr.sym_canon)
         if meta["SEGF"] != self.SEGF:
             # frontier re-segmentation is count-preserving (first-seen
             # is parent-order invariant), but a resumed run should be

@@ -31,7 +31,7 @@ CHECK_COUNTER_KEYS = (
     "distinct_states", "generated_states", "depth", "overflow_faults",
     "violations_global", "levels_fused", "burst_dispatches",
     "burst_bailouts", "pin_interior_states", "guard_matmul",
-    "dedup_kernel", "delta_matmul")
+    "dedup_kernel", "delta_matmul", "sym_canon")
 
 # the MXU-path mode flags (0/1): which expansion/dedup program this
 # run executed — BENCH rounds 9/11 read these next to the
@@ -39,7 +39,10 @@ CHECK_COUNTER_KEYS = (
 # attributes per phase AND records which mode produced each row.
 # Stamped LIVE by every engine's _stamp_mode (never serialized into
 # checkpoints — a resumed run reports the resuming engine's modes).
-MXU_COUNTER_KEYS = ("guard_matmul", "dedup_kernel", "delta_matmul")
+MXU_COUNTER_KEYS = ("guard_matmul", "dedup_kernel", "delta_matmul",
+                    # 1 = orbit-sort canonical fingerprints (round 15),
+                    # 0 = min-over-perms; the resolved --sym-canon mode
+                    "sym_canon")
 
 # the burst telemetry triple that must agree between the ledger,
 # --stats-json and checkpoint meta (the PR-5 drift class)

@@ -104,13 +104,42 @@ _SHARDED_FMT = ("ckpt_format", _SHARDED_CKPT_FORMAT,
                 "the carry replaced pg_off with the gids table and "
                 "gained trip_base")
 
+# warn-once latch for uneven user chunk overrides (per process, like
+# any stacklevel warning filter — the mesh size doesn't change mid-run)
+_warned_uneven_chunk = False
+
+
+def _round_chunk_to_devices(chunk: int, n_devices: int) -> int:
+    """Round ``chunk`` up to the next multiple of the mesh size.
+
+    The mesh engines shard the frontier chunk/D rows per device, so
+    the per-device row count must divide evenly.  Defaults (512, 2048)
+    already divide every power-of-two pod slice; a user override that
+    doesn't is rounded up (never down — capacities are sized FROM the
+    chunk) with a one-time warning naming both numbers."""
+    d = max(1, int(n_devices))
+    rem = int(chunk) % d
+    if rem == 0:
+        return int(chunk)
+    rounded = int(chunk) + (d - rem)
+    global _warned_uneven_chunk
+    if not _warned_uneven_chunk:
+        _warned_uneven_chunk = True
+        import warnings
+        warnings.warn(
+            f"chunk {chunk} is not a multiple of the {d}-device mesh; "
+            f"rounded up to {rounded} ({rounded // d} frontier rows "
+            "per device)", stacklevel=3)
+    return rounded
+
 
 class ShardedEngine(Engine):
     """Engine whose full BFS runs sharded over a device mesh with
     hash-ownership-partitioned visited/level key sets.
 
     chunk — GLOBAL frontier states expanded per step (chunk/D per
-    device); must be a multiple of the mesh size."""
+    device); rounded up to a multiple of the mesh size
+    (_round_chunk_to_devices — uneven overrides warn once)."""
 
     def __init__(self, cfg: ModelConfig, devices=None, chunk: int = 512,
                  store_states: bool = True,
@@ -121,12 +150,17 @@ class ShardedEngine(Engine):
                  guard_matmul: bool = True,
                  dedup_kernel: str = "auto",
                  delta_matmul: bool = True,
-                 fam_density=None):
+                 fam_density=None,
+                 sym_canon: str = "auto"):
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(np.array(devices), axis_names=("d",))
         self.D = len(devices)
-        assert chunk % self.D == 0, \
-            f"chunk {chunk} not divisible by {self.D} devices"
+        # pod-size-aware chunk: the frontier shards chunk/D rows per
+        # device, so chunk rounds UP to the next multiple of the mesh
+        # size instead of asserting — the default chunk then does the
+        # right thing on any pod slice; an uneven user override warns
+        # once (it was a deliberate number that no longer holds)
+        chunk = _round_chunk_to_devices(chunk, self.D)
         self.BL = chunk // self.D              # frontier rows per device
         super().__init__(cfg, chunk=chunk, store_states=store_states,
                          lcap=lcap, vcap=vcap, fcap=fcap, burst=burst,
@@ -134,7 +168,8 @@ class ShardedEngine(Engine):
                          guard_matmul=guard_matmul,
                          dedup_kernel=dedup_kernel,
                          delta_matmul=delta_matmul,
-                         fam_density=fam_density)
+                         fam_density=fam_density,
+                         sym_canon=sym_canon)
         # the sharded step computes full per-candidate fingerprints: the
         # incremental per-action path (engine/fingerprint) is not wired
         # into _local_step yet, so make the inherited flag's inertness
@@ -1257,6 +1292,7 @@ class ShardedEngine(Engine):
                            n_vis=[int(x) for x in n_vis],
                            n_front=int(n_front),
                            spec=self.ir.name,
+                           sym_canon=self.fpr.sym_canon,
                            ir_fingerprint=self.ir.fingerprint(),
                            cfg=repr(self.cfg)),
                        keep=self.ckpt_keep)
@@ -1328,7 +1364,8 @@ class ShardedEngine(Engine):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
                             ("D", "LB", "VB", "FC", "SC", "fam_caps"),
                             sharded=True, expected_format=_SHARDED_FMT,
-                            spec_name=self.ir.name)
+                            spec_name=self.ir.name,
+                            sym_canon=self.fpr.sym_canon)
         if meta["D"] != self.D:
             raise CheckpointError(
                 f"checkpoint was written on a {meta['D']}-device mesh; "

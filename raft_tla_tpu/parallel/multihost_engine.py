@@ -251,6 +251,7 @@ class MultiHostEngine(ShardedEngine):
                        n_vis=[int(x) for x in n_vis],
                        n_front=int(n_front),
                        spec=self.ir.name,
+                       sym_canon=self.fpr.sym_canon,
                        ir_fingerprint=self.ir.fingerprint(),
                        cfg=repr(self.cfg)))
 
@@ -261,7 +262,8 @@ class MultiHostEngine(ShardedEngine):
                             ("D", "n_proc", "proc", "d_idx", "LB", "VB",
                              "FC", "SC", "fam_caps"), sharded=True,
                             expected_format=_SHARDED_FMT,
-                            spec_name=self.ir.name)
+                            spec_name=self.ir.name,
+                            sym_canon=self.fpr.sym_canon)
         if meta["n_proc"] != jax.process_count() or \
                 meta["D"] != self.D:
             raise CheckpointError(

@@ -155,8 +155,10 @@ class PjitShardedEngine(Engine):
 
     devices — the mesh's devices; defaults to ``jax.devices()``, which
     under a multi-controller run (``multihost.init_distributed``)
-    spans every process's devices.  chunk should be a multiple of the
-    device count (uneven shardings work but waste tiles).
+    spans every process's devices.  chunk is rounded up to a multiple
+    of the device count (mesh._round_chunk_to_devices — an uneven
+    override warns once; uneven shardings would compile but waste
+    tiles on every step).
 
     Program identity: the compiled step/finalize/burst are the classic
     engine's traces — partitioning changes WHERE integer ops run,
@@ -168,6 +170,9 @@ class PjitShardedEngine(Engine):
         self.mesh = jax.make_mesh((len(devices),), ("d",),
                                   devices=devices)
         self.D = len(devices)
+        from .mesh import _round_chunk_to_devices
+        kw = dict(kw, chunk=_round_chunk_to_devices(
+            kw.get("chunk", 512), self.D))
         super().__init__(cfg, **kw)
         # the Pallas probe kernel is a single-device program; the lax
         # claim walk is the pjit program (its table scatter is the

@@ -678,6 +678,7 @@ class SpilledShardedEngine(ShardedEngine):
                            fam_caps=list(self.FAM_CAPS), **arch_meta,
                            layout=2, chunk=self.chunk,
                            spec=self.ir.name,
+                           sym_canon=self.fpr.sym_canon,
                            ir_fingerprint=self.ir.fingerprint(),
                            cfg=repr(self.cfg)),
                        keep=self.ckpt_keep)
@@ -686,7 +687,8 @@ class SpilledShardedEngine(ShardedEngine):
         z, meta = ckpt_read(path, repr(self.cfg), self.chunk,
                             self._SM_EXTRA_KEYS, sharded=True,
                             spill=True, expected_format=self._SM_FMT,
-                            spec_name=self.ir.name)
+                            spec_name=self.ir.name,
+                            sym_canon=self.fpr.sym_canon)
         if meta["D"] != self.D:
             raise CheckpointError(
                 f"checkpoint was written on a {meta['D']}-device "

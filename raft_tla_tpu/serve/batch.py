@@ -389,7 +389,7 @@ class BucketEngine:
 
     def __init__(self, cfg, chunk: int = 128, vcap: int = 1 << 15,
                  burst_levels: int = 8, delta_matmul: bool = True,
-                 exec_cache=None):
+                 sym_canon: str = "auto", exec_cache=None):
         from ..engine.bfs import Engine
         # dedup_kernel="off": the Pallas probe kernel has no batching
         # rule; the lax claim walk is bit-identical in every mode
@@ -403,7 +403,8 @@ class BucketEngine:
         self.eng = Engine(cfg, chunk=chunk, store_states=False,
                           vcap=vcap, dedup_kernel="off",
                           burst_levels=burst_levels,
-                          delta_matmul=delta_matmul)
+                          delta_matmul=delta_matmul,
+                          sym_canon=sym_canon)
         self.KB = self.eng._burst_width()
         self.VCAP = self.eng.VCAP
         self._fn = self.eng.burst_batched_fn()
@@ -453,6 +454,10 @@ class BucketEngine:
             "W": eng.W,
             "guard_matmul": eng.guard_matmul,
             "delta_matmul": eng.expander.delta_active,
+            # the RESOLVED canonicalization mode: sort and minperm
+            # compile different fingerprint programs AND produce
+            # different table values — never share an executable
+            "sym_canon": eng.fpr.sym_canon,
             "incremental_fp": bool(eng.incremental_fp and
                                    eng.fpr.supports_incremental()),
             "rt_mode": self.rt_mode,
@@ -814,14 +819,18 @@ class BucketEngine:
 # ---------------------------------------------------------------------------
 
 def _run_solo(job: Job, obs, meta: Dict, status: str,
-              reason: Optional[str]) -> JobOutcome:
+              reason: Optional[str],
+              sym_canon: str = "auto") -> JobOutcome:
     """One job on its own Engine (the sequential reference path):
     used for --sequential runs, batched-path fallbacks, and seeded/
     pinned jobs.  Engine dispatches ride the same obs bundle, so the
-    ledger records the solo device traffic honestly."""
+    ledger records the solo device traffic honestly.  sym_canon
+    follows any bucket override so a fallback job dedups with the
+    same canonicalization program its bucket would have."""
     from ..engine.bfs import Engine
     with obs.span("sequential_job"):
-        eng = Engine(job.cfg, store_states=job.store_states)
+        eng = Engine(job.cfg, store_states=job.store_states,
+                     sym_canon=sym_canon)
         meta["engines_compiled"] += 1
         res = eng.check(max_depth=job.max_depth,
                         max_states=job.max_states,
@@ -1041,7 +1050,9 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
                                 if st == "fallback")
     for i, status, reason in solo:
         wait_s = time.perf_counter() - slo.t_submit
-        outcomes[i] = _run_solo(jobs[i], obs, meta, status, reason)
+        outcomes[i] = _run_solo(jobs[i], obs, meta, status, reason,
+                                sym_canon=(bucket_overrides or {})
+                                .get("sym_canon", "auto"))
         res = outcomes[i].res
         outcomes[i].report["wait_s"] = round(wait_s, 3)
         outcomes[i].report["service_s"] = round(res.seconds, 3)

@@ -84,13 +84,17 @@ from ..spec import spec_of
 from ..engine.expand import Expander
 from ..engine.bfs import enable_persistent_compilation_cache
 from ..engine.fingerprint import (Fingerprinter, bloom_estimate,
-                                  bloom_positions)
+                                  bloom_positions, resolve_sym_canon)
 
 BLOOM_K = 2
-# symmetry groups past this size pay more in per-step canonical
-# hashing than the novelty estimate is worth (the same threshold
+# under FORCED min-over-perms (--sym-canon minperm), symmetry groups
+# past this size pay more in per-step P-fold hashing than the novelty
+# estimate is worth (the same threshold
 # fingerprint.supports_incremental uses); the Bloom falls back to
-# identity-permutation fingerprints, honestly labeled in the result
+# identity-permutation fingerprints, honestly labeled in the result.
+# The orbit-sort canonicalizer (--sym-canon sort/auto, round 15)
+# hashes ONE relabeling per state, so it keeps the Bloom canonical at
+# ANY group size — this cap only gates the minperm path.
 _BLOOM_CANONICAL_MAX_PERMS = 24
 
 
@@ -177,7 +181,8 @@ class SimEngine:
                  traj_cap: Optional[int] = None,
                  bloom_bits: int = 22, wid_base: int = 0,
                  guard_matmul: bool = True,
-                 delta_matmul: bool = True):
+                 delta_matmul: bool = True,
+                 sym_canon: str = "auto"):
         enable_persistent_compilation_cache()
         if policy not in ("punctuated", "tlc"):
             raise ValueError(f"unknown restart policy {policy!r}")
@@ -208,12 +213,21 @@ class SimEngine:
                                  delta_matmul=self.delta_matmul)
         fp_cfg = cfg
         self.bloom_canonical = True
-        if cfg.symmetry:
+        mode = resolve_sym_canon(cfg, sym_canon)
+        if cfg.symmetry and mode == "minperm":
             if len(self.ir.symmetry_perms(cfg)) > \
                     _BLOOM_CANONICAL_MAX_PERMS:
+                import warnings
+                warnings.warn(
+                    f"--sym-canon minperm with "
+                    f"{len(self.ir.symmetry_perms(cfg))} perms: the "
+                    "novelty Bloom falls back to identity-permutation "
+                    "fingerprints (bloom_canonical=false) — use "
+                    "--sym-canon sort (or auto) to keep it canonical",
+                    stacklevel=2)
                 fp_cfg = cfg.with_(symmetry=False)
                 self.bloom_canonical = False
-        self.fpr = Fingerprinter(fp_cfg)
+        self.fpr = Fingerprinter(fp_cfg, sym_canon=mode)
         self.preds = self.ir.make_predicates(self.lay)
         # punctuated-restart progress ladder: a SpecIR hook (the raft
         # scenario ladder lives in spec/raft_ir.sim_progress); a spec

@@ -98,8 +98,17 @@ class SpecIR:
     glob_dependent: frozenset = frozenset()
 
     # ---- identity ------------------------------------------------------
-    make_fingerprinter: Callable = None   # cfg -> fingerprinter
+    # make_fingerprinter receives the RESOLVED sym_canon mode ("sort" |
+    # "minperm") from engine/fingerprint.Fingerprinter (round 15).
+    make_fingerprinter: Callable = None   # (cfg, sym_canon) -> fingerprinter
     symmetry_perms: Callable = None       # cfg -> [perm tuples]
+    # orbit-sort signature kernel (round 15): (fingerprinter, svT,
+    # prep) -> u32[S, B] permutation-EQUIVARIANT per-server signature
+    # (sig(relabel(s,σ))[σ(i)] == sig(s)[i]); svT is batch-last, prep
+    # is the fingerprinter's own spec-defined precompute object.
+    # Signature strength is performance-only — the certificate +
+    # min-over-perms fallback in the fingerprinter pins correctness.
+    server_signature: Callable = None
 
     # ---- oracle twins (the differential anchor) ------------------------
     oracle_explore: Callable = None       # explore(cfg, **kw)

@@ -33,8 +33,45 @@ from .model import symmetry_perms
 U32 = jnp.uint32
 
 
+def _fmix32_np(x: np.ndarray) -> np.ndarray:
+    """Host-side murmur3 finalizer twin (uint32 wrapping)."""
+    x = np.asarray(x, np.uint32)
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(0x85EBCA6B)
+        x = x ^ (x >> np.uint32(13))
+        x = x * np.uint32(0xC2B2AE35)
+        x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def paxos_acceptor_signature(fpr, svT: Dict, bits) -> jnp.ndarray:
+    """Paxos ``server_signature`` hook body: permutation-EQUIVARIANT
+    per-acceptor signature u32[N, B].  Acceptor a's column of
+    mb/vb/vv folds order-preservingly over instances (per-instance
+    salts), and every message bit the acceptor OWNS (the 1b/2b
+    blocks) adds its role weight — the bit's index with the owner
+    relabeled to 0, hashed — so two acceptors tie exactly when their
+    columns match and they own the same multiset of messages up to
+    their own label.  No refinement rounds: the paxos layout has no
+    acceptor-acceptor relations to refine over."""
+    isalt = jnp.asarray(fpr._inst_salts)[:, None, None]  # [I, 1, 1]
+    c = None
+    for key, s in (("mb", 0x6B79D8A5), ("vb", 0x27D4EB2F),
+                   ("vv", 0x165667B1)):
+        M = svT[key].astype(U32)                         # [I, N, B]
+        fold = jnp.sum(fmix32(M ^ isalt ^ U32(s)), axis=0)
+        c = fold if c is None else fmix32(c + fold)
+    rw = jnp.asarray(fpr._role_w)                        # [N, n_bits]
+    c = fmix32(c + jnp.sum(rw[:, :, None] * bits[None].astype(U32),
+                           axis=1))
+    return c
+
+
 class PaxosFingerprinter:
-    def __init__(self, cfg):
+    def __init__(self, cfg, sym_canon: str = "minperm"):
+        assert sym_canon in ("sort", "minperm"), sym_canon
+        self.sym_canon = sym_canon
         self.cfg = cfg
         self.lay = PaxosLayout(cfg)
         lay = self.lay
@@ -64,6 +101,51 @@ class PaxosFingerprinter:
             [np.stack([self.pos_salts[t][idx[p]]
                        for t in range(self.n_streams)])
              for p in range(len(perms))])       # [P, n_streams, n_pos]
+        if sym_canon == "sort":
+            self._init_sort(cfg, lay)
+
+    def _init_sort(self, cfg, lay):
+        """Orbit-sort precompute (round 15).  Acceptor ids appear only
+        as POSITIONS, and every owned message bit's layout index is
+        AFFINE in its owning acceptor (idx_1b/idx_2b are linear in
+        ``a``), so the per-lane salt permutation is pure index
+        arithmetic: bit j's salt under σ sits at
+        j + (σ(owner_j) − owner_j)·stride_j (identity for the unowned
+        1a/2a blocks).  owner/stride are derived from the closed forms
+        and cross-checked against perm_bit_map at init."""
+        N, B, V = lay.N, lay.B, lay.V
+        owner = np.zeros(lay.n_msg_bits, np.int32)
+        stride = np.zeros(lay.n_msg_bits, np.int32)
+        s1b = B * (B + 1) * (V + 1)
+        j1b = np.arange(lay.off_2a - lay.off_1b)
+        owner[lay.off_1b:lay.off_2a] = (j1b // s1b) % N
+        stride[lay.off_1b:lay.off_2a] = s1b
+        s2b = B * V
+        j2b = np.arange(lay.n_msg_bits - lay.off_2b)
+        owner[lay.off_2b:] = (j2b // s2b) % N
+        stride[lay.off_2b:] = s2b
+        jar = np.arange(lay.n_msg_bits)
+        for sig in (np.roll(np.arange(N), 1), np.arange(N)[::-1]):
+            ref = lay.perm_bit_map(tuple(int(x) for x in sig))
+            chk = jar + (sig[owner] - owner) * stride
+            assert np.array_equal(np.asarray(ref), chk), \
+                "paxos owner/stride bit map diverged from perm_bit_map"
+        self._bit_owner, self._bit_stride = owner, stride
+        # role id: the bit's index with its owner relabeled to 0 —
+        # equal for bits that are the same message up to the acceptor
+        # label, distinct otherwise.  role_w[a, j] weights bit j into
+        # acceptor a's signature multiset (0 for unowned bits).
+        role = (jar - owner.astype(np.int64) * stride).astype(np.uint32)
+        rw = _fmix32_np(role * np.uint32(0x9E3779B1)
+                        + np.uint32(0x85EBCA6B))
+        owned = stride > 0
+        self._role_w = np.where(
+            owned[None, :] & (owner[None, :] == np.arange(N)[:, None]),
+            rw[None, :], np.uint32(0))           # [N, n_msg_bits]
+        self._inst_salts = _salts(lay.I, 44)
+        self._sort_salt = _salts(self.n_streams, 49)
+        from .. import spec_of
+        self._sig_fn = spec_of(cfg).server_signature
 
     def supports_incremental(self) -> bool:
         """No incremental-delta path yet: Paxos configs are small and
@@ -72,6 +154,19 @@ class PaxosFingerprinter:
         return False
 
     # ------------------------------------------------------------------
+
+    def _hash_under(self, flat, nb: int, psalt) -> jnp.ndarray:
+        """One salted positional hash -> u32[n_streams, ...]; psalt is
+        a static [T, n_pos] table (min-over-perms path) or a per-lane
+        gathered [T, n_pos, B] one (orbit-sort path)."""
+        tail = (1,) * nb
+        out = []
+        for t in range(self.n_streams):
+            p_t = psalt[t]
+            if p_t.ndim == 1:
+                p_t = p_t.reshape((self.n_pos,) + tail)
+            out.append(jnp.sum(fmix32(flat ^ p_t), axis=0))
+        return jnp.stack(out)                          # [n_streams, ...]
 
     def _core(self, svT: Dict, nb: int) -> jnp.ndarray:
         lay = self.lay
@@ -85,17 +180,99 @@ class PaxosFingerprinter:
         flat = jnp.concatenate(
             [p.reshape((-1,) + p.shape[p.ndim - nb:]).astype(U32)
              for p in scal] + [bits])                  # [n_pos, ...]
-
-        def one_perm(psalt):
-            out = []
-            for t in range(self.n_streams):
-                h = jnp.sum(fmix32(flat ^ psalt[t].reshape(
-                    (self.n_pos,) + tail)), axis=0)
-                out.append(h)
-            return jnp.stack(out)                      # [n_streams, ...]
-
-        hs = jax.vmap(one_perm)(jnp.asarray(self.psalts))
+        if self.sym_canon == "sort" and len(self.sigmas) > 1:
+            assert nb == 1          # fingerprint() wraps with B=1
+            return self._core_sort(svT, flat, bits)
+        hs = jax.vmap(lambda p: self._hash_under(flat, nb, p))(
+            jnp.asarray(self.psalts))
         return self._seal(self._lex_min(hs))
+
+    # ---- orbit-sort path (round 15; engine/fingerprint._core_sort is
+    # the documented twin — same certificate + cond-gated fallback
+    # algebra, minus value rewrites, which Paxos simply has none of) --
+
+    def _sort_perm(self, sig):
+        """sig [N, B] -> (π [N, B] old→canonical slot, adjacent-tie
+        certificates).  The paxos group is the full S_N: one block."""
+        N = self.lay.N
+        order = jnp.argsort(sig, axis=0, stable=True).astype(jnp.int32)
+        col = jnp.arange(sig.shape[1])[None, :]
+        pi = jnp.zeros_like(order)
+        pi = pi.at[order, col].set(jnp.broadcast_to(
+            jnp.arange(N, dtype=jnp.int32)[:, None], order.shape))
+        ss = jnp.take_along_axis(sig, order, axis=0)
+        ties = [(r, r + 1, ss[r] == ss[r + 1]) for r in range(N - 1)]
+        return pi, ties
+
+    def _dyn_psalts(self, pi):
+        """pos_salts gathered under a PER-LANE permutation: the jnp
+        mirror of __init__'s static index construction, with the
+        message-bit block as the affine owner/stride map."""
+        lay = self.lay
+        I, N = lay.I, lay.N
+        B = pi.shape[1:]
+        parts, off = [], 0
+        iar = jnp.arange(I, dtype=jnp.int32)[:, None, None]
+        for _blk in range(3):                          # mb vb vv
+            blkidx = off + iar * N + pi[None]
+            parts.append(blkidx.reshape((I * N,) + B))
+            off += I * N
+        jar = jnp.arange(lay.n_msg_bits, dtype=jnp.int32)[:, None]
+        own = jnp.asarray(self._bit_owner)
+        stride = jnp.asarray(self._bit_stride)[:, None]
+        parts.append(off + jar + (pi[own] - own[:, None]) * stride)
+        idx = jnp.concatenate(parts)                   # [n_pos, B]
+        return jnp.stack([jnp.asarray(self.pos_salts[t])[idx]
+                          for t in range(self.n_streams)])
+
+    def _sort_hashes(self, svT: Dict, flat, bits):
+        sig = self._sig_fn(self, svT, bits)            # [N, B] u32
+        pi, ties = self._sort_perm(sig)
+        h0 = self._hash_under(flat, 1, self._dyn_psalts(pi))
+        hard = jnp.zeros(h0.shape[1:], bool)
+        tie = jnp.zeros(h0.shape[1:], bool)
+        for a, b, eq in ties:
+            tie = tie | eq
+            pit = jnp.where(pi == a, b, jnp.where(pi == b, a, pi))
+            ht = self._hash_under(flat, 1, self._dyn_psalts(pit))
+            same = jnp.ones_like(hard)
+            for t in range(self.n_streams):
+                same = same & (ht[t] == h0[t])
+            hard = hard | (eq & ~same)
+        return h0, hard, tie
+
+    def _core_sort(self, svT: Dict, flat, bits) -> jnp.ndarray:
+        h0, hard, _tie = self._sort_hashes(svT, flat, bits)
+
+        def _fallback(_):
+            hs = jax.vmap(lambda p: self._hash_under(flat, 1, p))(
+                jnp.asarray(self.psalts))
+            return self._lex_min(hs)
+
+        fp_min = jax.lax.cond(jnp.any(hard), _fallback,
+                              lambda _: jnp.zeros_like(h0), None)
+        fp = jnp.where(hard[None], fp_min, h0)
+        fp = fmix32(fp ^ jnp.asarray(self._sort_salt)[:, None])
+        return self._seal(fp)
+
+    def sort_debug(self, svb: Dict) -> Dict:
+        """Test/bench hook: per-state (hard, tie) masks for a batch-
+        FIRST [B, ...] state dict under the sort canonicalizer."""
+        assert self.sym_canon == "sort"
+        lay = self.lay
+        svT = {k: jnp.moveaxis(jnp.asarray(v), 0, -1)
+               for k, v in svb.items()}
+        words = svT["msgs"]
+        j = np.arange(lay.n_msg_bits)
+        sh = jnp.asarray((j & 31).astype(np.uint32)).reshape(
+            (lay.n_msg_bits, 1))
+        bits = ((words[j >> 5] >> sh) & U32(1)).astype(U32)
+        scal = [svT["mb"], svT["vb"], svT["vv"]]
+        flat = jnp.concatenate(
+            [p.reshape((-1,) + p.shape[p.ndim - 1:]).astype(U32)
+             for p in scal] + [bits])
+        _h0, hard, tie = self._sort_hashes(svT, flat, bits)
+        return dict(hard=np.asarray(hard), tie=np.asarray(tie))
 
     def _lex_min(self, hs) -> jnp.ndarray:
         best = hs[0]
@@ -122,6 +299,9 @@ class PaxosFingerprinter:
     # ---- the three engine entry points (raft-interface twins) ----------
 
     def fingerprint(self, sv: Dict) -> jnp.ndarray:
+        if self.sym_canon == "sort" and len(self.sigmas) > 1:
+            svT = {k: jnp.asarray(v)[..., None] for k, v in sv.items()}
+            return self._core(svT, nb=1)[..., 0]
         return self._core(sv, nb=0)
 
     def fingerprint_batch(self, svb: Dict) -> jnp.ndarray:

@@ -207,9 +207,13 @@ def build_ir() -> SpecIR:
     from .oracle import explore
     from .vpredicates import (PaxosPredicates, SCENARIO_PROPERTIES)
 
-    def make_fingerprinter(cfg):
+    def make_fingerprinter(cfg, sym_canon="minperm"):
         from .fingerprint import PaxosFingerprinter
-        return PaxosFingerprinter(cfg)
+        return PaxosFingerprinter(cfg, sym_canon=sym_canon)
+
+    def server_signature(fpr, svT, prep):
+        from .fingerprint import paxos_acceptor_signature
+        return paxos_acceptor_signature(fpr, svT, prep)
 
     return SpecIR(
         name="paxos",
@@ -235,6 +239,7 @@ def build_ir() -> SpecIR:
         glob_dependent=GLOB_DEPENDENT,
         make_fingerprinter=make_fingerprinter,
         symmetry_perms=symmetry_perms,
+        server_signature=server_signature,
         oracle_explore=explore,
         oracle_successors=successors,
         oracle_walk_key=walk_key,

@@ -373,9 +373,13 @@ def build_ir() -> SpecIR:
     from ..ops.vpredicates import (CONSTRAINTS as VC, INVARIANTS as VI,
                                    Predicates, SCENARIO_PROPERTIES)
 
-    def make_fingerprinter(cfg):
+    def make_fingerprinter(cfg, sym_canon="minperm"):
         from ..engine.fingerprint import RaftFingerprinter
-        return RaftFingerprinter(cfg)
+        return RaftFingerprinter(cfg, sym_canon=sym_canon)
+
+    def server_signature(fpr, svT, prep):
+        from ..engine.fingerprint import raft_server_signature
+        return raft_server_signature(fpr, svT, prep)
 
     return SpecIR(
         name="raft",
@@ -401,6 +405,7 @@ def build_ir() -> SpecIR:
         glob_dependent=frozenset(OP.GLOB_DEPENDENT),
         make_fingerprinter=make_fingerprinter,
         symmetry_perms=symmetry_perms,
+        server_signature=server_signature,
         oracle_explore=explore,
         oracle_successors=successors,
         oracle_walk_key=_walk_key,

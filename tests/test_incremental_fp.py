@@ -117,4 +117,11 @@ def test_counts_match_direct_engine():
 def test_big_symmetry_group_falls_back():
     cfg = MICRO.with_(n_servers=5, init_servers=(0, 1, 2, 3, 4))
     eng = Engine(cfg, chunk=16, store_states=False)
+    # P = 120: auto resolves to orbit-sort, whose data-dependent
+    # canonical permutation has no per-perm delta algebra
+    assert eng.fpr.sym_canon == "sort"
+    assert not eng.fpr.supports_incremental()
+    # forced minperm past 24 perms falls back too (the historical gate)
+    eng = Engine(cfg, chunk=16, store_states=False,
+                 sym_canon="minperm")
     assert not eng.fpr.supports_incremental()    # P = 120 > 24

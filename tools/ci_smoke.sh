@@ -37,3 +37,8 @@ env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 # (whole-state named shardings) ≡ the default engine, reference-less
 # CLI A/B count parity
 env JAX_PLATFORMS=cpu python tools/pjit_smoke.py
+# orbit-sort canonicalization gate (round 15): depth-capped CLI
+# `--sym-canon sort` (one argsorted canonical hash) ≡ `minperm` (the
+# P-fold min-over-perms) count parity, raft block-product group AND
+# paxos full S_N, with the stats mode flag pinned 1/0
+env JAX_PLATFORMS=cpu python tools/sym_smoke.py

@@ -102,6 +102,24 @@ def warm_pjit(tag, cfg, **kw):
     del eng
 
 
+def warm_sym(tag, cfg, **kw):
+    """Canonicalization-mode warm (round 15): a symmetric config
+    compiles DISTINCT fingerprint programs under --sym-canon sort
+    (argsort canonicalization + transposition certificates + the
+    cond-gated min-over-perms fallback) vs minperm (the P-fold min) —
+    auto picks exactly one, so a bench _canon_ab or deep_run A/B
+    session would pay the other's cold compile mid-run.  One depth-2
+    check per mode lands both in the persistent cache."""
+    from raft_tla_tpu.engine.bfs import Engine
+    t0 = time.time()
+    for mode in ("sort", "minperm"):
+        eng = Engine(cfg, store_states=False, sym_canon=mode, **kw)
+        eng.check(max_depth=2)
+    print(f"{tag}: sym-canon modes warmed in {time.time() - t0:.1f}s "
+          f"(chunk={eng.chunk} P={len(eng.fpr.sigmas)})", flush=True)
+    del eng
+
+
 def warm_resume(tag, cfg, **kw):
     """Resume-repartition warm (round 12): checkpoint a depth-2 run,
     load the portable image and resume it on the spill engine — this
@@ -164,6 +182,17 @@ def main():
         # the pod-scale sharded program (round 14) — its executables
         # are distinct cache entries from the classic engine's
         warm_pjit("pjit micro", micro, chunk=256)
+        # both canonicalization modes (round 15) at bench _canon_ab's
+        # exact shape: the config-#5 S=5/P=120 space where auto picks
+        # sort — without this the forced-minperm A/B twin compiles cold
+        from raft_tla_tpu.config import Bounds as _B, ModelConfig, \
+            NEXT_ASYNC
+        warm_sym("canon A/B config-5 shape", ModelConfig(
+            n_servers=5, init_servers=(0, 1, 2, 3, 4), values=(1,),
+            next_family=NEXT_ASYNC, symmetry=True,
+            max_inflight_override=4,
+            bounds=_B.make(max_log_length=2, max_timeouts=1,
+                           max_client_requests=1)), chunk=256)
         warm("bench headline", build_cfg(2), chunk=2048,
              lcap=bench.LCAP, vcap=bench.VCAP)
         # deep_run's spill probe shape, host table OFF and ON: the ON

@@ -1,0 +1,78 @@
+"""Orbit-sort canonicalization CI smoke (tools/ci_smoke.sh, round 15).
+
+Depth-capped CLI checks with ``--sym-canon sort`` (ONE argsorted
+canonical relabeling hashed per state) vs ``--sym-canon minperm``
+(the P-fold min-over-perms) must land on IDENTICAL counts — for a
+symmetric raft config whose perm group has the inside/outside block
+structure AND for the stock paxos model (full S_N, owned-bit affine
+salt map).  Exercises the end-to-end flag wiring (CLI → engine →
+Fingerprinter) plus the stats mode flag (sym_canon 1/0).
+
+Sub-minute on CPU; the full-space duplicates and the oracle-partition
+parity live in tests/test_sym_canon.py.  Exits 0 on identity, 1 with
+a message on any divergence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fail(msg):
+    print(f"sym_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_one(spec_args, mode, stats_path):
+    cmd = [sys.executable, "-m", "raft_tla_tpu", "check"] + \
+        spec_args + ["--sym-canon", mode, "--stats-json", stats_path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, env=env, cwd=_REPO,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        fail(f"check {' '.join(spec_args[:1])} --sym-canon {mode} "
+             f"failed rc={proc.returncode}:\n{proc.stderr}")
+    with open(stats_path) as fh:
+        return json.load(fh)
+
+
+def ab(name, spec_args, td):
+    srt = run_one(spec_args, "sort",
+                  os.path.join(td, f"{name}_sort.json"))
+    mnp = run_one(spec_args, "minperm",
+                  os.path.join(td, f"{name}_minperm.json"))
+    if srt.get("sym_canon") != 1 or mnp.get("sym_canon") != 0:
+        fail(f"{name}: mode flags wrong: sort={srt.get('sym_canon')} "
+             f"minperm={mnp.get('sym_canon')} — the CLI flag did not "
+             "reach the engine")
+    for key in ("distinct_states", "generated_states", "depth",
+                "dedup_hit_rate", "violations"):
+        if srt[key] != mnp[key]:
+            fail(f"{name} {key}: sort {srt[key]} != minperm "
+                 f"{mnp[key]} — the orbit partitions diverged")
+    print(f"sym_smoke: {name} sort ≡ minperm at depth {srt['depth']} "
+          f"({srt['distinct_states']} orbits)")
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="sym_smoke_") as td:
+        # S=3 ⊋ init=2: the block-product perm group (P=2), forced
+        # sort — auto would pick minperm at this size, and the smoke
+        # must pin the sort program itself
+        ab("raft", [
+            os.path.join(_REPO, "configs", "tlc_membership",
+                         "raft.cfg"),
+            "--servers", "3", "--init-servers", "2", "--symmetry",
+            "--max-log-length", "1", "--max-timeouts", "1",
+            "--max-client-requests", "1", "--max-depth", "6"], td)
+        ab("paxos", ["--spec", "paxos", "--max-depth", "6"], td)
+    print("sym_smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
