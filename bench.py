@@ -947,7 +947,32 @@ def _canon_ab(out_path):
     return out
 
 
-def _no_reference_fallback():
+def _bench_registry_record(registry_dir, headline):
+    """Append one ``cmd="bench"`` record to a run registry (ISSUE 17)
+    so ``cli obs ls/diff/regress`` can query bench results next to
+    check runs — the headline detail's numeric fields become the
+    record's counters (the parity keys obs/report.py compares)."""
+    if not registry_dir:
+        return
+    import time as _time
+
+    from raft_tla_tpu.obs.registry import RunRegistry, new_run_id
+    from raft_tla_tpu.obs.resources import backend_fingerprint
+    detail = headline.get("detail") or {}
+    counters = {k: v for k, v in detail.items()
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)}
+    RunRegistry(registry_dir).append({
+        "run_id": new_run_id(), "cmd": "bench", "status": "finished",
+        "finished_ts": round(_time.time(), 3),
+        "metric": headline.get("metric"),
+        "value": headline.get("value"),
+        "counters": counters,
+        "backend": backend_fingerprint(),
+        "headline": headline})
+
+
+def _no_reference_fallback(registry=None):
     """Containers without the reference checkout (and without the TPU)
     cannot run the headline metric at all — emit ONE honestly-labeled
     JSON line instead of a traceback, carrying the only measurement
@@ -1032,7 +1057,7 @@ def _no_reference_fallback():
     canon_ab = _canon_ab(os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "BENCH_r14.json"))
     gate_ok = gate_ok and canon_ab["status"] == "ok"
-    print(json.dumps({
+    out = {
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
         "value": None, "unit": "states/sec", "vs_baseline": None,
         "status": "headline skipped: /root/reference cfgs and the TPU "
@@ -1085,7 +1110,9 @@ def _no_reference_fallback():
                        "fingerprint_phase_speedup":
                            canon_ab["fingerprint_phase_speedup"],
                        "hard_fallback_rate":
-                           canon_ab["hard_fallback_rate"]}}}))
+                           canon_ab["hard_fallback_rate"]}}}
+    print(json.dumps(out))
+    _bench_registry_record(registry, out)
 
 
 def main():
@@ -1095,9 +1122,20 @@ def main():
     from raft_tla_tpu.engine.bfs import Engine
     from raft_tla_tpu.models.explore import explore
 
+    # --registry parses before the reference check: the fallback path
+    # (this container) records a queryable cmd="bench" row too
+    argv = sys.argv[1:]
+    registry = None
+    if "--registry" in argv:
+        i = argv.index("--registry")
+        if i + 1 >= len(argv):
+            raise SystemExit("--registry needs a DIR argument")
+        registry = argv[i + 1]
+        del argv[i:i + 2]
+
     # -- correctness gate (micro config, fast) --------------------------
     if not os.path.exists("/root/reference/tlc_membership/raft.cfg"):
-        _no_reference_fallback()
+        _no_reference_fallback(registry)
         return
     micro = load_model("/root/reference/tlc_membership/raft.cfg",
                        bounds=Bounds.make(max_log_length=1, max_timeouts=1,
@@ -1133,7 +1171,6 @@ def main():
     # avoid silently reinterpreting old invocations).  --chunk exists
     # to let the perf-floor trip be exercised deliberately.
     max_depth, chunk = MAX_DEPTH, 2048
-    argv = sys.argv[1:]
     while argv:
         if len(argv) >= 2 and argv[0] == "--max-depth":
             max_depth = int(argv[1])
@@ -1147,9 +1184,9 @@ def main():
             argv = argv[2:]
         else:
             raise SystemExit("usage: python bench.py [--max-depth N] "
-                             "[--chunk C]   (the metric is depth-exact "
-                             "now; the old positional state budget was "
-                             "removed)")
+                             "[--chunk C] [--registry DIR]   (the "
+                             "metric is depth-exact now; the old "
+                             "positional state budget was removed)")
 
     # -- CPU baseline: the native checker, same depth-exact run ---------
     threads = os.cpu_count() or 8
@@ -1252,6 +1289,7 @@ def main():
     out["detail"]["pjit_ab_status"] = pjit_ab["status"]
     out["detail"]["canon_ab_status"] = canon_ab["status"]
     print(json.dumps(out))
+    _bench_registry_record(registry, out)
 
 
 if __name__ == "__main__":

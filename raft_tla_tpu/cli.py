@@ -252,7 +252,8 @@ def _engine_seed_arrays(cfg, ir, engine_seeds):
     return out
 
 
-_OBS_ARGS = ("ledger", "heartbeat", "trace_timeline", "profile_dir")
+_OBS_ARGS = ("ledger", "heartbeat", "trace_timeline", "profile_dir",
+             "registry")
 
 
 def _obs_flags_set(args) -> bool:
@@ -261,23 +262,33 @@ def _obs_flags_set(args) -> bool:
     return any(getattr(args, nm, None) for nm in _OBS_ARGS)
 
 
-def _build_obs(args, ir=None):
+def _build_obs(args, ir=None, cfg=None, cmd=None):
     """The observability bundle the flags describe (obs package);
     NULL_OBS when no flag is set.  ``ir`` stamps the active spec name
-    + IR fingerprint into every ledger record."""
+    + IR fingerprint into every ledger record; ``cfg``/``cmd`` ride
+    the run-level context (ledger meta row + registry record only —
+    a cfg repr is too bulky for every dispatch row)."""
     from .obs import from_flags
     meta = ({"spec": ir.name, "ir_fingerprint": ir.fingerprint()}
             if ir is not None else None)
+    run_info = {}
+    if cmd is not None:
+        run_info["cmd"] = cmd
+    if cfg is not None:
+        run_info["cfg"] = repr(cfg)
     return from_flags(ledger=getattr(args, "ledger", None),
                       heartbeat=getattr(args, "heartbeat", None),
                       timeline=getattr(args, "trace_timeline", None),
                       profile_dir=getattr(args, "profile_dir", None),
-                      meta=meta)
+                      meta=meta,
+                      registry=getattr(args, "registry", None),
+                      run_info=run_info or None)
 
 
 def _add_obs_flags(sp):
-    """--ledger/--heartbeat/--trace-timeline/--profile-dir, shared by
-    check and simulate (tools/deep_run.py exposes the same four)."""
+    """--ledger/--heartbeat/--trace-timeline/--profile-dir/--registry,
+    shared by check, simulate and batch (tools/deep_run.py exposes the
+    same set)."""
     sp.add_argument("--ledger", default=None, metavar="FILE",
                     help="append one JSONL record per dispatch (depth, "
                          "frontier, registry counters, states/sec, "
@@ -300,6 +311,12 @@ def _add_obs_flags(sp):
                          "jax.profiler.trace into DIR; span names ride "
                          "along as TraceAnnotations so the device "
                          "trace lines up with --trace-timeline")
+    sp.add_argument("--registry", default=None, metavar="DIR",
+                    help="append one atomic schema-versioned run "
+                         "record (counters, span rollups, resource "
+                         "peaks, backend fingerprint, exit status, "
+                         "artifact paths) under DIR at run end; query "
+                         "with `cli obs ls/show/diff/regress`")
 
 
 def _install_chaos(args):
@@ -380,9 +397,9 @@ def cmd_check(args):
             # the oracle has no dispatches to ledger/heartbeat; say so
             # instead of silently writing nothing (and do NOT build
             # the bundle — that would touch the files)
-            print("--ledger/--heartbeat/--trace-timeline/--profile-dir "
-                  "instrument the tpu engines; ignored for "
-                  "--engine oracle", file=sys.stderr)
+            print("--ledger/--heartbeat/--trace-timeline/--profile-dir"
+                  "/--registry instrument the tpu engines; ignored "
+                  "for --engine oracle", file=sys.stderr)
         t0 = time.perf_counter()
         r = explore(cfg, max_depth=args.max_depth,
                     max_states=args.max_states,
@@ -461,7 +478,7 @@ def cmd_check(args):
             eng.ckpt_keep = args.ckpt_keep
             return eng
         from .resil.supervisor import RetryExhausted, supervised_check
-        obs = _build_obs(args, ir)
+        obs = _build_obs(args, ir, cfg=cfg, cmd="check")
         obs.start()
         done = False
         try:
@@ -498,7 +515,9 @@ def cmd_check(args):
             # a watchdog sees "finished" with depth == the stats line)
             if done:
                 obs.finish(depth=int(r.depth),
-                           states=int(r.distinct_states))
+                           states=int(r.distinct_states),
+                           counters=r.metrics.as_dict(),
+                           level_sizes=list(r.level_sizes))
             else:
                 obs.finish(status="failed")
         secs = r.seconds
@@ -692,7 +711,7 @@ def cmd_simulate(args):
         eng = ShardedSimEngine(cfg, walkers=args.walkers, **kw)
     else:
         eng = SimEngine(cfg, walkers=args.walkers, **kw)
-    obs = _build_obs(args, ir)
+    obs = _build_obs(args, ir, cfg=cfg, cmd="simulate")
     obs.start()
     t0 = time.perf_counter()
     done = False
@@ -703,8 +722,10 @@ def cmd_simulate(args):
         done = True
     finally:
         if done:
+            from .obs.metrics import sim_counters
             obs.finish(depth=int(r.steps_dispatched),
-                       states=int(r.walker_steps))
+                       states=int(r.walker_steps),
+                       counters=sim_counters(r))
         else:
             obs.finish(status="failed")
     # the ONE simulate stats assembler (obs.metrics.sim_stats) — same
@@ -816,7 +837,7 @@ def cmd_batch(args):
         exec_cache = ExecCache(
             args.executable_cache,
             max_bytes=args.executable_cache_max_bytes)
-    obs = _build_obs(args)
+    obs = _build_obs(args, cmd="batch")
     obs.start()
     done = False
     rep = None
@@ -857,7 +878,12 @@ def cmd_batch(args):
                 depth=max((int(o.report.get("depth", 0))
                            for o in rep.outcomes), default=0),
                 states=sum(int(o.report.get("distinct_states", 0))
-                           for o in rep.outcomes))
+                           for o in rep.outcomes),
+                # the batch summary's scalar counters (jobs, buckets,
+                # cache hits, dispatches) are the run's registry record
+                counters={k: v for k, v in rep.summary.items()
+                          if isinstance(v, (int, float))
+                          and not isinstance(v, bool)})
         else:
             obs.finish(status="failed")
     print(json.dumps(rep.summary))
@@ -870,6 +896,114 @@ def cmd_batch(args):
     n_viol = sum(int(o.report.get("violations", 0))
                  for o in rep.outcomes)
     return 1 if n_viol else 0
+
+
+def _load_baseline_file(path, row):
+    """A committed baseline for ``obs regress``: a --stats-json
+    payload, a bench headline object, a registry record, or a BENCH
+    A/B file with a ``rows`` map (then --baseline-row picks one)."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    if isinstance(obj, dict) and isinstance(obj.get("rows"), dict):
+        if not row:
+            raise SystemExit(
+                f"{path} holds multiple A/B rows; pick one with "
+                f"--baseline-row (known: "
+                f"{', '.join(sorted(obj['rows']))})")
+        if row not in obj["rows"]:
+            raise SystemExit(
+                f"--baseline-row {row!r} not in {path} (known: "
+                f"{', '.join(sorted(obj['rows']))})")
+        return obj["rows"][row]
+    if row:
+        raise SystemExit(f"--baseline-row given but {path} has no "
+                         "'rows' map")
+    return obj
+
+
+def cmd_obs(args):
+    """``cli obs`` — the registry's query surface (obs/report.py).
+
+    ls      — filterable run table (newest last).
+    show    — one run's full record (counters, span rollups,
+              resource peaks, artifacts) as indented JSON.
+    diff    — machine-readable parity verdict + per-phase span deltas
+              between two runs; exit 1 on count mismatch.
+    regress — a run against a prior run (--against) or a committed
+              baseline file (--baseline); exit 1 on count mismatch or
+              a tripped --max-span-ratio bound, 2 on usage errors.
+
+    Run tokens: a full run id, a unique id prefix, or ``last``."""
+    from .obs.registry import RunRegistry
+    from .obs.report import diff_runs, regress
+    reg = RunRegistry(args.registry)
+
+    def resolve(token):
+        rid = reg.resolve(token)
+        if rid is None:
+            ids = reg.run_ids()
+            print(f"no unique run matches {token!r} in "
+                  f"{args.registry} ({len(ids)} records"
+                  + (f"; newest {ids[-1]}" if ids else "")
+                  + ")", file=sys.stderr)
+        return rid
+
+    if args.obs_cmd == "ls":
+        rows = []
+        for rid, rec in reg.records():
+            if args.spec and rec.get("spec") != args.spec:
+                continue
+            if args.cmd_filter and rec.get("cmd") != args.cmd_filter:
+                continue
+            if args.status and rec.get("status") != args.status:
+                continue
+            rows.append(rec)
+        print(f"{'run_id':34s} {'cmd':9s} {'spec':6s} {'status':9s} "
+              f"{'depth':>6s} {'states':>10s} {'seconds':>8s}")
+        for rec in rows:
+            print(f"{str(rec.get('run_id', '?')):34s} "
+                  f"{str(rec.get('cmd', '?')):9s} "
+                  f"{str(rec.get('spec', '-')):6s} "
+                  f"{str(rec.get('status', '?')):9s} "
+                  f"{str(rec.get('depth', '-')):>6s} "
+                  f"{str(rec.get('distinct_states', '-')):>10s} "
+                  f"{str(rec.get('seconds', '-')):>8s}")
+        return 0
+    if args.obs_cmd == "show":
+        rid = resolve(args.run)
+        if rid is None:
+            return 2
+        print(json.dumps(reg.load(rid), indent=1))
+        return 0
+    if args.obs_cmd == "diff":
+        ra, rb = resolve(args.run_a), resolve(args.run_b)
+        if ra is None or rb is None:
+            return 2
+        rep = diff_runs(reg.load(ra), reg.load(rb))
+        print(json.dumps(rep))
+        return 1 if rep["verdict"] == "mismatch" else 0
+    if args.obs_cmd == "regress":
+        if bool(args.against) == bool(args.baseline):
+            print("obs regress needs exactly one of --against RUN / "
+                  "--baseline FILE", file=sys.stderr)
+            return 2
+        rid = resolve(args.run)
+        if rid is None:
+            return 2
+        if args.against:
+            bid = resolve(args.against)
+            if bid is None:
+                return 2
+            baseline = reg.load(bid)
+        else:
+            baseline = _load_baseline_file(args.baseline,
+                                           args.baseline_row)
+        rep, code = regress(reg.load(rid), baseline,
+                            max_span_ratio=args.max_span_ratio,
+                            min_seconds=args.min_seconds)
+        print(json.dumps(rep))
+        return code
+    raise SystemExit(f"unknown obs subcommand {args.obs_cmd!r}")
 
 
 def main(argv=None):
@@ -1268,6 +1402,72 @@ def main(argv=None):
     pb.add_argument("--verbose", "-v", action="store_true")
     _add_obs_flags(pb)
     pb.set_defaults(fn=cmd_batch)
+
+    po = sub.add_parser(
+        "obs",
+        help="query the run registry: ls (run table), show RUN, "
+             "diff A B (parity verdict + span deltas), regress "
+             "(verdict vs a prior run or committed baseline; exit "
+             "nonzero on count mismatch / span-ratio regression)")
+    osub = po.add_subparsers(dest="obs_cmd", required=True)
+
+    def _reg_flag(sp):
+        sp.add_argument("--registry", required=True, metavar="DIR",
+                        help="the registry directory earlier runs "
+                             "recorded into")
+
+    ols = osub.add_parser("ls", help="list recorded runs (newest last)")
+    _reg_flag(ols)
+    ols.add_argument("--spec", default=None,
+                     help="only runs of this spec frontend")
+    ols.add_argument("--cmd", dest="cmd_filter", default=None,
+                     help="only runs of this command (check/simulate/"
+                          "batch/deep_run/bench)")
+    ols.add_argument("--status", default=None,
+                     help="only runs with this exit status "
+                          "(finished/failed)")
+
+    oshow = osub.add_parser(
+        "show", help="one run's full record (counters, span rollups, "
+                     "resource peaks, artifact paths) as JSON")
+    _reg_flag(oshow)
+    oshow.add_argument("run", help="run id, unique prefix, or 'last'")
+
+    odiff = osub.add_parser(
+        "diff", help="machine-readable diff of two runs: count/"
+                     "level-size parity verdict, per-phase span "
+                     "deltas, mode-flag drift by name; exit 1 on "
+                     "count mismatch")
+    _reg_flag(odiff)
+    odiff.add_argument("run_a", help="run id, unique prefix, or 'last'")
+    odiff.add_argument("run_b", help="run id, unique prefix, or 'last'")
+
+    oreg = osub.add_parser(
+        "regress", help="regression verdict of RUN against a prior "
+                        "registry run or a committed baseline file; "
+                        "exit 1 on regression, 2 on usage error")
+    _reg_flag(oreg)
+    oreg.add_argument("run", help="run id, unique prefix, or 'last'")
+    oreg.add_argument("--against", default=None, metavar="RUN",
+                      help="baseline = this prior registry run")
+    oreg.add_argument("--baseline", default=None, metavar="FILE",
+                      help="baseline = a committed JSON file: a "
+                           "--stats-json payload, a bench headline "
+                           "object, or a BENCH_*.json A/B file "
+                           "(then --baseline-row picks the row)")
+    oreg.add_argument("--baseline-row", default=None, metavar="KEY",
+                      help="row key inside a BENCH file's 'rows' map")
+    oreg.add_argument("--max-span-ratio", type=float, default=None,
+                      metavar="R",
+                      help="also fail when a shared phase's span time "
+                           "exceeds R x the baseline's (phases under "
+                           "--min-seconds in the baseline are exempt "
+                           "— CI wall-clock noise)")
+    oreg.add_argument("--min-seconds", type=float, default=0.05,
+                      metavar="S",
+                      help="span-ratio floor: baseline phases shorter "
+                           "than S seconds never trip (default 0.05)")
+    po.set_defaults(fn=cmd_obs)
 
     args = p.parse_args(argv)
     _honor_platform_env()

@@ -17,6 +17,15 @@ each optional and individually cheap enough to leave on:
   (``--profile-dir``) with ``TraceAnnotation`` names matching the span
   names, so the XLA device trace lines up with the host timeline.
 
+ISSUE 17 adds the cross-run half: every bundle carries a **run id**
+(stamped into every ledger row and heartbeat, so interleaved/resumed
+runs demultiplex), a **resource sampler** (`obs/resources.py` — RSS /
+device-memory peaks + compile wall-clock, sampled at every dispatch),
+and an optional **registry** (`obs/registry.py`, ``--registry DIR``)
+that receives one atomic schema-versioned record per run at
+``finish()`` — counters, span rollups, resource peaks, backend
+fingerprint, exit status, artifact paths.  ``cli obs`` queries it.
+
 Engines take ``obs=None`` in ``check()``/``run()`` and default to
 ``NULL_OBS`` (every hook a no-op); the CLI builds a real bundle from
 the flags via ``from_flags`` and owns its lifecycle
@@ -27,6 +36,7 @@ the flags via ``from_flags`` and owns its lifecycle
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Dict, Optional
 
@@ -36,12 +46,15 @@ from .metrics import (BURST_COUNTER_KEYS, CHECK_COUNTER_KEYS,
                       MXU_COUNTER_KEYS, SIM_COUNTER_KEYS,
                       SIM_DISPATCH_KEYS, MetricsRegistry, check_stats,
                       sim_counters, sim_stats)
+from .registry import RunRegistry, new_run_id
+from .resources import ResourceSampler, backend_fingerprint
 from .spans import SpanRecorder
 
 __all__ = [
     "Obs", "NULL_OBS", "from_flags", "SpanRecorder", "RunLedger",
-    "Heartbeat", "MetricsRegistry", "check_stats", "sim_stats",
-    "sim_counters", "rss_bytes", "device_memory_stats",
+    "Heartbeat", "MetricsRegistry", "RunRegistry", "ResourceSampler",
+    "check_stats", "sim_stats", "sim_counters", "rss_bytes",
+    "device_memory_stats", "backend_fingerprint", "new_run_id",
     "CHECK_COUNTER_KEYS", "BURST_COUNTER_KEYS", "MXU_COUNTER_KEYS",
     "SIM_COUNTER_KEYS", "SIM_DISPATCH_KEYS",
 ]
@@ -58,20 +71,46 @@ class Obs:
                  ledger: Optional[RunLedger] = None,
                  heartbeat: Optional[Heartbeat] = None,
                  profile_dir: Optional[str] = None,
-                 meta: Optional[Dict] = None):
+                 meta: Optional[Dict] = None,
+                 registry: Optional[RunRegistry] = None,
+                 run_info: Optional[Dict] = None):
         self.spans = spans
         self.ledger = ledger
         self.heartbeat = heartbeat
         self.profile_dir = profile_dir
+        self.registry = registry
         # run-constant stamp merged into every ledger record (the CLI
         # passes the active spec name + IR fingerprint here, so every
         # dispatch line names the frontend that compiled the run)
         self.meta = dict(meta or {})
+        # run-level context for the meta row + registry record ONLY
+        # (cmd name, cfg repr — too bulky to ride every dispatch row)
+        self.run_info = dict(run_info or {})
         self._profiling = False
         self._t0 = time.perf_counter()
+        self._started_ts = time.time()
         self._n_dispatch = 0
         self._last_jobs = None
         self._last_slo = None
+        self._last_metrics: Optional[Dict] = None
+        # one id per run, stamped into every ledger row (RunLedger's
+        # stamp), the heartbeat, and the registry record, so
+        # interleaved/resumed runs demultiplex and a registry record
+        # cross-links its artifact files
+        self.run_id = new_run_id() if (
+            ledger is not None or heartbeat is not None
+            or registry is not None) else None
+        if self.run_id is not None:
+            if ledger is not None:
+                ledger.stamp["run_id"] = self.run_id
+            if heartbeat is not None:
+                heartbeat.run_id = self.run_id
+        # resource sampler (obs/resources): fed at every dispatch,
+        # surfaced on heartbeats, as throttled kind="resource" ledger
+        # rows, and as the registry record's rollup
+        self._resources = ResourceSampler(spans=spans) if (
+            ledger is not None or heartbeat is not None
+            or registry is not None) else None
         if profile_dir and spans is not None:
             # device traces only line up with the host timeline if the
             # TraceAnnotation names match the span names
@@ -81,7 +120,8 @@ class Obs:
     def enabled(self) -> bool:
         return (self.spans is not None or self.ledger is not None
                 or self.heartbeat is not None
-                or self.profile_dir is not None)
+                or self.profile_dir is not None
+                or self.registry is not None)
 
     # -- hooks the engines call ---------------------------------------
 
@@ -108,9 +148,23 @@ class Obs:
         queue line — and the ledger record carries queue_depth."""
         self._n_dispatch += 1
         metrics = metrics or {}
+        if metrics:
+            self._last_metrics = dict(metrics)
         if states is None:
             states = int(metrics.get("distinct_states",
                                      metrics.get("walker_steps", 0)))
+        res_snap = None
+        if self._resources is not None:
+            res_snap = self._resources.sample()
+            if self.ledger is not None and self._resources.due():
+                # the resource row precedes the dispatch row: the
+                # ledger's FINAL record stays the final dispatch record
+                # (obs_smoke pins that contract)
+                rrec = dict(self.meta)
+                rrec["kind"] = "resource"
+                rrec["depth"] = int(depth)
+                rrec.update(res_snap)
+                self.ledger.record(rrec)
         if self.ledger is not None:
             secs = time.perf_counter() - self._t0
             # counters first, header fields second: the registry's
@@ -150,6 +204,8 @@ class Obs:
                 extra["jobs"] = jobs
             if slo is not None:
                 extra["slo"] = dict(slo)
+            if res_snap is not None:
+                extra["resources"] = res_snap
             self.heartbeat.beat(depth=depth, states=states,
                                 extra=extra or None)
 
@@ -188,6 +244,18 @@ class Obs:
 
     def start(self):
         self._t0 = time.perf_counter()
+        self._started_ts = time.time()
+        if self.ledger is not None:
+            # ONE kind="meta" row at run start: run id (ledger stamp),
+            # spec + IR fingerprint (meta), pid, cmd/cfg context and
+            # the shared backend fingerprint — every ledger names the
+            # process and backend that produced it
+            rec = dict(self.meta)
+            rec.update(self.run_info)
+            rec["kind"] = "meta"
+            rec["pid"] = os.getpid()
+            rec["backend"] = backend_fingerprint()
+            self.ledger.record(rec)
         if self.profile_dir:
             import jax
             jax.profiler.start_trace(self.profile_dir)
@@ -195,7 +263,9 @@ class Obs:
         return self
 
     def finish(self, depth: Optional[int] = None,
-               states: Optional[int] = None, status: str = "finished"):
+               states: Optional[int] = None, status: str = "finished",
+               counters: Optional[Dict] = None,
+               level_sizes=None):
         if self._profiling:
             import jax
             try:
@@ -218,7 +288,45 @@ class Obs:
                 extra=(({"jobs": self._last_jobs}
                         if self._last_jobs is not None else {}) |
                        ({"slo": self._last_slo}
-                        if self._last_slo is not None else {})) or None)
+                        if self._last_slo is not None else {}) |
+                       ({"resources": self._resources.sample()}
+                        if self._resources is not None else {})) or
+                None)
+        if self.registry is not None:
+            # ONE atomic schema-versioned record per run — the
+            # cross-run half of the obs layer (obs/registry).
+            # ``counters`` is the final metrics snapshot when the
+            # caller has it (r.metrics.as_dict()); otherwise the last
+            # dispatched snapshot stands in (its `depth` counter may
+            # lag — the top-level depth field is authoritative)
+            rec = dict(self.meta)
+            rec.update(self.run_info)
+            rec["run_id"] = self.run_id
+            rec["status"] = status
+            rec["started_ts"] = round(self._started_ts, 3)
+            rec["finished_ts"] = round(time.time(), 3)
+            rec["seconds"] = round(time.perf_counter() - self._t0, 3)
+            if depth is not None:
+                rec["depth"] = int(depth)
+            if states is not None:
+                rec["distinct_states"] = int(states)
+            rec["counters"] = dict(counters if counters is not None
+                                   else self._last_metrics or {})
+            if level_sizes is not None:
+                rec["level_sizes"] = [int(x) for x in level_sizes]
+            rec["spans"] = (self.spans.totals()
+                            if self.spans is not None else {})
+            rec["resources"] = (self._resources.rollup()
+                                if self._resources is not None else {})
+            rec["backend"] = backend_fingerprint()
+            rec["artifacts"] = {
+                k: v for k, v in (
+                    ("ledger", getattr(self.ledger, "path", None)),
+                    ("heartbeat",
+                     getattr(self.heartbeat, "path", None)),
+                    ("timeline", getattr(self.spans, "path", None)),
+                    ("profile_dir", self.profile_dir)) if v}
+            self.registry.append(rec)
         if self.ledger is not None:
             self.ledger.close()
         if self.spans is not None:
@@ -238,14 +346,22 @@ def from_flags(ledger: Optional[str] = None,
                heartbeat: Optional[str] = None,
                timeline: Optional[str] = None,
                profile_dir: Optional[str] = None,
-               meta: Optional[Dict] = None) -> Obs:
+               meta: Optional[Dict] = None,
+               registry: Optional[str] = None,
+               run_info: Optional[Dict] = None) -> Obs:
     """Build the bundle the CLI flags describe (NULL_OBS when none are
-    set, so callers can pass the result unconditionally)."""
-    if not (ledger or heartbeat or timeline or profile_dir):
+    set, so callers can pass the result unconditionally).  A registry
+    without a timeline still gets an in-memory SpanRecorder: the run
+    record's span rollups (and the sampler's compile seconds) must
+    exist whether or not a trace file was requested."""
+    if not (ledger or heartbeat or timeline or profile_dir
+            or registry):
         return NULL_OBS
     return Obs(
-        spans=SpanRecorder(timeline) if (timeline or profile_dir)
-        else None,
+        spans=SpanRecorder(timeline)
+        if (timeline or profile_dir or registry) else None,
         ledger=RunLedger(ledger) if ledger else None,
         heartbeat=Heartbeat(heartbeat) if heartbeat else None,
-        profile_dir=profile_dir, meta=meta)
+        profile_dir=profile_dir, meta=meta,
+        registry=RunRegistry(registry) if registry else None,
+        run_info=run_info)

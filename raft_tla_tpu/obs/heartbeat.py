@@ -24,6 +24,9 @@ class Heartbeat:
         self._pid = os.getpid()
         self._started = time.time()
         self._beats = 0
+        # the owning run's id (Obs sets it): cross-links the heartbeat
+        # to the ledger rows and registry record of the same run
+        self.run_id: Optional[str] = None
         # last-known progress, so a terminal "failed" beat (which has
         # no fresher numbers) can still stamp the file
         self.last_depth = 0
@@ -43,6 +46,8 @@ class Heartbeat:
             "started_ts": round(self._started, 3),
             "beats": self._beats,
         }
+        if self.run_id is not None:
+            obj["run_id"] = self.run_id
         if extra:
             obj.update(extra)
         # write-then-rename: a reader never sees a torn file, and a

@@ -13,10 +13,19 @@ it).  ``tools/watch.py`` tails it for live progress.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import time
 from typing import Dict, Optional
+
+# monotonic per-PROCESS record sequence (ISSUE 17 satellite): shared
+# across every RunLedger in the process, so records of interleaved
+# runs (or one run appending after a resume) order deterministically
+# even when two ledgers target the same file; readers pair it with
+# the per-run ``run_id`` stamp to demultiplex.  Old rows without the
+# keys still parse — readers use .get().
+_SEQ = itertools.count(1)
 
 
 def rss_bytes() -> int:
@@ -61,6 +70,10 @@ class RunLedger:
 
     def __init__(self, path: str):
         self.path = path
+        # run-constant keys applied to EVERY record via setdefault
+        # (Obs installs {"run_id": ...} here, so rows recorded
+        # directly by the serving layer carry it too)
+        self.stamp: Dict = {}
         # append, never truncate: a resumed run (--resume after a
         # dropped tunnel) must extend the pre-crash telemetry, which is
         # exactly the record the ledger exists to preserve
@@ -69,6 +82,9 @@ class RunLedger:
 
     def record(self, rec: Dict):
         rec = dict(rec)
+        for k, v in self.stamp.items():
+            rec.setdefault(k, v)
+        rec.setdefault("seq", next(_SEQ))
         rec.setdefault("ts", round(time.time(), 3))
         rec.setdefault("t_mono", round(time.perf_counter() - self._t0, 6))
         self._fh.write(json.dumps(rec) + "\n")

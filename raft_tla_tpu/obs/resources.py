@@ -1,0 +1,127 @@
+"""Resource telemetry: backend identity + a low-overhead sampler.
+
+Two things live here (ISSUE 17):
+
+- ``backend_fingerprint()`` — the platform/device-kind/device-count/
+  jax-version identity of this process' backend.  It started life in
+  ``serve/exec_cache.py`` as part of the executable cache key; the obs
+  layer stamps the SAME dict on every ledger meta row and registry
+  record, so it is hoisted here as the single shared helper
+  (exec_cache re-exports it for its cache keys).
+
+- ``ResourceSampler`` — sampled at level/burst dispatch boundaries by
+  ``Obs.dispatch`` (so every engine driver is covered without per-
+  driver hooks): host RSS (+ running peak), jax device memory stats
+  (HBM in-use/peak where the backend reports them — XLA:CPU reports
+  nothing), and per-executable compile wall-clock read from the span
+  recorder's ``compile``/``bucket_compile`` totals.  Samples surface
+  three ways: as the ``resources`` field on every heartbeat, as
+  throttled ``kind="resource"`` ledger rows (first dispatch
+  immediately, then at most one per ``interval_s``), and as the
+  ``resources`` rollup of the run's registry record.  This directly
+  serves the ROADMAP carry-over items "archive run at depth 21+ with
+  bounded RSS" and "30-50 s TPU compile": both are now measured fields
+  of every run instead of scrollback folklore.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from .ledger import device_memory_stats, rss_bytes
+
+__all__ = ["backend_fingerprint", "ResourceSampler"]
+
+
+def backend_fingerprint() -> Dict[str, str]:
+    """The identity of this process' backend: platform, device kind,
+    device count, jax version.  ONE definition — the executable cache
+    keys on it (an executable serialized on one backend never loads on
+    another) and the obs layer stamps it on every ledger meta row and
+    registry record (a run record without its backend is not
+    comparable)."""
+    import jax
+    devs = jax.devices()
+    return {
+        "platform": jax.default_backend(),
+        "device_kind": str(devs[0].device_kind) if devs else "none",
+        "n_devices": str(len(devs)),
+        "jax": jax.__version__,
+    }
+
+
+# span names whose totals count as executable-compile wall-clock (the
+# classic engines warm under "compile"; the serving layer AOT-compiles
+# under "bucket_compile")
+_COMPILE_SPANS = ("compile", "bucket_compile")
+
+
+class ResourceSampler:
+    """Peak-tracking sampler, driven by ``Obs.dispatch``.
+
+    spans      — optional SpanRecorder; its compile-span totals become
+                 the ``compile_seconds``/``compile_count`` fields.
+    interval_s — minimum spacing of ``kind="resource"`` ledger rows
+                 (``due()``); heartbeats carry every sample regardless.
+    """
+
+    def __init__(self, spans=None, interval_s: float = 30.0):
+        self.spans = spans
+        self.interval_s = float(interval_s)
+        self._last_emit: Optional[float] = None
+        self._n_samples = 0
+        self._rss_peak = 0
+        self._dev_peak_in_use = 0
+        self._dev_peak = 0          # backend-reported peak_bytes_in_use
+
+    def sample(self) -> Dict:
+        """One sample: current RSS + running peak, device memory where
+        reported, compile totals so far.  Cheap enough for every
+        dispatch (one /proc read + one memory_stats call)."""
+        self._n_samples += 1
+        rss = rss_bytes()
+        self._rss_peak = max(self._rss_peak, rss)
+        snap = {"rss_bytes": rss, "rss_peak_bytes": self._rss_peak}
+        dev = device_memory_stats()
+        if dev:
+            self._dev_peak_in_use = max(self._dev_peak_in_use,
+                                        int(dev.get("bytes_in_use", 0)))
+            self._dev_peak = max(self._dev_peak,
+                                 int(dev.get("peak_bytes_in_use", 0)))
+            snap["device_memory"] = dev
+        snap.update(self._compile_totals())
+        return snap
+
+    def _compile_totals(self) -> Dict:
+        secs, count = 0.0, 0
+        if self.spans is not None:
+            tot = self.spans.totals()
+            for nm in _COMPILE_SPANS:
+                if nm in tot:
+                    secs += float(tot[nm]["seconds"])
+                    count += int(tot[nm]["count"])
+        return {"compile_seconds": round(secs, 3),
+                "compile_count": count}
+
+    def due(self) -> bool:
+        """Throttle for ledger rows: True on the first call and then
+        at most once per ``interval_s`` (a tiny CI run gets exactly
+        one resource row; a days-scale run gets a bounded stream)."""
+        now = time.perf_counter()
+        if self._last_emit is not None and \
+                now - self._last_emit < self.interval_s:
+            return False
+        self._last_emit = now
+        return True
+
+    def rollup(self) -> Dict:
+        """The registry record's resources summary: sample count,
+        peaks, compile totals."""
+        out = {"samples": self._n_samples,
+               "rss_peak_bytes": self._rss_peak}
+        out.update(self._compile_totals())
+        if self._dev_peak_in_use or self._dev_peak:
+            out["device_peak_bytes_in_use"] = max(
+                self._dev_peak, self._dev_peak_in_use)
+        return out

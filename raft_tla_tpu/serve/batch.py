@@ -436,7 +436,8 @@ class BucketEngine:
         executable — serve/exec_cache docstring.  The ceiling cfg repr
         covers the predicate name lists, symmetry and fp128; the
         engine fields cover the program's static shapes and modes."""
-        from .exec_cache import backend_fingerprint, code_fingerprint
+        from ..obs.resources import backend_fingerprint
+        from .exec_cache import code_fingerprint
         eng = self.eng
         return {
             "backend": backend_fingerprint(),

@@ -41,20 +41,11 @@ from typing import Dict, Optional, Tuple
 
 _FORMAT = 1
 
-
-def backend_fingerprint() -> Dict[str, str]:
-    """The executable-compatibility identity of this process' backend:
-    platform, device kind, device count, jax version.  Part of every
-    cache key — an executable serialized on one backend never loads on
-    another."""
-    import jax
-    devs = jax.devices()
-    return {
-        "platform": jax.default_backend(),
-        "device_kind": str(devs[0].device_kind) if devs else "none",
-        "n_devices": str(len(devs)),
-        "jax": jax.__version__,
-    }
+# the backend identity helper was born here as part of the cache key;
+# ISSUE 17 hoisted it to obs/resources.py (the obs layer stamps the
+# same dict on every ledger meta row and registry record) — re-exported
+# so cache-key call sites keep importing it from here
+from ..obs.resources import backend_fingerprint  # noqa: E402,F401
 
 
 _CODE_FP = None

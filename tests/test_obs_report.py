@@ -1,0 +1,360 @@
+"""Run registry + report engine (raft_tla_tpu/obs/registry,report —
+ISSUE 17): atomic append, corrupt-record tolerance, parity/mode-drift
+verdicts on REAL engine runs, regress exit codes through the CLI, the
+resource-telemetry fields, per-process ledger seq demux, and the
+cadence-aware watch stall detection.
+
+One module-scope engine keeps the suite fast: a single compile warms
+the jit caches via the depth-gated run (which doubles as the injected-
+mismatch record), then the two full runs A/B record into the same
+registry the CLI-level tests query."""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from raft_tla_tpu.obs import from_flags
+from raft_tla_tpu.obs.registry import RunRegistry, new_run_id
+from raft_tla_tpu.obs.report import (diff_runs, extract,
+                                     format_span_totals, regress)
+from test_obs import TINY
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_watch():
+    spec = importlib.util.spec_from_file_location(
+        "watch", os.path.join(_REPO, "tools", "watch.py"))
+    watch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(watch)
+    return watch
+
+
+# ---------------------------------------------------------------------
+# unit tests (smoke tier: no device programs)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_registry_append_atomic_and_resolve(tmp_path):
+    reg = RunRegistry(str(tmp_path / "reg"))
+    with pytest.raises(ValueError):
+        reg.append({"cmd": "check"})          # no run_id: loud
+    ra, rb = "r20260806-000001-1-aaaaaa", "r20260806-000002-1-bbbbbb"
+    reg.append({"run_id": ra, "cmd": "check", "status": "finished"})
+    reg.append({"run_id": rb, "cmd": "bench", "status": "finished"})
+    assert reg.run_ids() == [ra, rb]
+    # atomic publish: no tmp leftovers, schema stamped
+    assert not [n for n in os.listdir(reg.root) if n.endswith(".tmp")]
+    assert reg.load(ra)["schema"] == 1
+    assert reg.resolve(ra) == ra
+    assert reg.resolve("last") == rb
+    assert reg.resolve("r20260806-000001") == ra   # unique prefix
+    assert reg.resolve("r2026") is None            # ambiguous
+    assert reg.resolve("nope") is None
+
+
+@pytest.mark.smoke
+def test_registry_corrupt_record_skipped_with_warning(tmp_path, capsys):
+    reg = RunRegistry(str(tmp_path / "reg"))
+    rid = new_run_id()
+    reg.append({"run_id": rid, "cmd": "check"})
+    bad = os.path.join(reg.root, "rzz-corrupt.json")
+    with open(bad, "w") as fh:
+        fh.write("{ torn mid-wr")
+    got = dict(reg.records())
+    assert set(got) == {rid}
+    err = capsys.readouterr().err
+    assert "skipping corrupt record" in err and "rzz-corrupt" in err
+
+
+@pytest.mark.smoke
+def test_format_span_totals():
+    s = format_span_totals({"harvest": {"count": 4, "seconds": 0.5},
+                            "compile": {"count": 1, "seconds": 6.1}})
+    assert s == "compile=6.10s/1  harvest=0.50s/4"
+
+
+@pytest.mark.smoke
+def test_extract_shapes():
+    # flat --stats-json payload: numeric keys become counters
+    e = extract({"distinct_states": 7, "depth": 3, "seconds": 0.1,
+                 "guard_matmul": 1})
+    assert e["counters"]["distinct_states"] == 7
+    assert e["level_sizes"] is None
+    # bench headline: descend into detail
+    e = extract({"metric": "m", "value": 1.0,
+                 "detail": {"distinct_states": 7, "depth": 3}})
+    assert e["counters"]["depth"] == 3
+    # BENCH A/B row: phase_seconds/phase_counts become span totals
+    e = extract({"distinct_states": 7,
+                 "phase_seconds": {"expand": 1.5},
+                 "phase_counts": {"expand": 3}})
+    assert e["spans"]["expand"] == {"count": 3, "seconds": 1.5}
+    # deep_run row: "distinct" fills distinct_states
+    assert extract({"distinct": 9})["counters"]["distinct_states"] == 9
+
+
+@pytest.mark.smoke
+def test_regress_span_ratio_opt_in():
+    base = {"run_id": "ra", "counters": {"distinct_states": 5},
+            "spans": {"x": {"count": 1, "seconds": 1.0},
+                      "tiny": {"count": 1, "seconds": 0.001}}}
+    run = {"run_id": "rb", "counters": {"distinct_states": 5},
+           "spans": {"x": {"count": 1, "seconds": 10.0},
+                     "tiny": {"count": 1, "seconds": 1.0}}}
+    rep, code = regress(run, base)            # ratios off by default
+    assert code == 0 and rep["verdict"] == "ok"
+    rep, code = regress(run, base, max_span_ratio=2.0)
+    assert code == 1
+    assert any("span 'x' regressed" in f for f in rep["failures"])
+    # the sub-min_seconds baseline phase never trips (CI noise guard)
+    assert not any("tiny" in f for f in rep["failures"])
+
+
+@pytest.mark.smoke
+def test_ledger_seq_demux_and_legacy_rows(tmp_path):
+    """tools/watch.py rate estimation demuxes interleaved runs by
+    (run_id, seq); pre-ISSUE-17 rows carry neither and still parse."""
+    watch = _load_watch()
+    path = str(tmp_path / "ledger.jsonl")
+    rows = [
+        # legacy rows: no run_id, no seq
+        {"kind": "level", "distinct_states": 10, "seconds": 1.0},
+        {"kind": "level", "distinct_states": 20, "seconds": 2.0},
+    ]
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    legacy = watch.last_ledger_records(path)
+    assert [r["distinct_states"] for r in legacy] == [10, 20]
+    # a resumed run appends stamped rows (out of file order, even):
+    # only the NEWEST run id's rows feed the rate, in seq order
+    more = [
+        {"kind": "meta", "run_id": "r2", "seq": 1},
+        {"kind": "level", "run_id": "r2", "seq": 3,
+         "distinct_states": 99, "seconds": 9.0},
+        {"kind": "resource", "run_id": "r2", "seq": 4},
+        {"kind": "level", "run_id": "r2", "seq": 2,
+         "distinct_states": 50, "seconds": 5.0},
+    ]
+    with open(path, "a") as fh:
+        for r in more:
+            fh.write(json.dumps(r) + "\n")
+    got = watch.last_ledger_records(path)
+    assert [r["seq"] for r in got] == [2, 3]
+    assert all(r["run_id"] == "r2" for r in got)
+
+
+@pytest.mark.smoke
+def test_watch_cadence_stall(tmp_path):
+    """A heartbeat whose age exceeds N x its own observed cadence
+    flags STALLED? before the absolute --stale bound trips."""
+    watch = _load_watch()
+    now = time.time()
+    hb_path = str(tmp_path / "hb.json")
+
+    def write_hb(last_ts, started_ts, beats):
+        with open(hb_path, "w") as fh:
+            json.dump({"pid": os.getpid(), "status": "running",
+                       "depth": 5, "states_enqueued": 100,
+                       "last_dispatch_ts": last_ts,
+                       "started_ts": started_ts, "beats": beats}, fh)
+
+    # 9 beats over 40s -> 5s cadence; 120s silence >> 8x5s (and the
+    # 30s floor), yet far under the 10000s absolute bound
+    write_hb(now - 120, now - 160, beats=9)
+    line, code = watch.status_line(hb_path, None, stale_s=10_000)
+    assert code == 1 and "STALLED?" in line and "cadence" in line
+    # same silence, too few beats: no cadence estimate, healthy
+    write_hb(now - 120, now - 160, beats=3)
+    line, code = watch.status_line(hb_path, None, stale_s=10_000)
+    assert code == 0 and "STALLED" not in line
+    # fresh heartbeat with a cadence: healthy
+    write_hb(now - 2, now - 42, beats=9)
+    line, code = watch.status_line(hb_path, None, stale_s=10_000)
+    assert code == 0 and "STALLED" not in line
+    # --cadence-factor 0 disables the cadence branch entirely
+    write_hb(now - 120, now - 160, beats=9)
+    line, code = watch.status_line(hb_path, None, stale_s=10_000,
+                                   cadence_factor=0)
+    assert code == 0
+    # the absolute --stale bound still wins when older than it
+    line, code = watch.status_line(hb_path, None, stale_s=60)
+    assert code == 1 and "STALLED?" in line
+
+
+# ---------------------------------------------------------------------
+# real-run tests: one engine, one registry, three recorded runs
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def runs(tmp_path_factory):
+    from raft_tla_tpu.engine.bfs import Engine
+    td = tmp_path_factory.mktemp("obs_report")
+    reg_dir = str(td / "registry")
+    eng = Engine(TINY, chunk=64, store_states=False)
+    ids = {}
+
+    def record(tag, **kw):
+        obs = from_flags(ledger=str(td / f"{tag}.jsonl"),
+                         heartbeat=str(td / f"{tag}.hb.json"),
+                         registry=reg_dir,
+                         run_info={"cmd": "check", "cfg": repr(TINY)},
+                         meta={"spec": eng.ir.name,
+                               "ir_fingerprint": eng.ir.fingerprint()})
+        obs.start()
+        r = eng.check(obs=obs, **kw)
+        obs.finish(depth=int(r.depth), states=int(r.distinct_states),
+                   counters=r.metrics.as_dict(),
+                   level_sizes=[int(x) for x in r.level_sizes])
+        ids[tag] = obs.run_id
+        return r
+
+    record("gated", max_depth=2)   # warms the caches AND is the
+    record("a")                    # injected-mismatch record
+    record("b")
+    return {"dir": td, "reg_dir": reg_dir, "ids": ids}
+
+
+def test_diff_clean_on_identical_runs(runs):
+    reg = RunRegistry(runs["reg_dir"])
+    rep = diff_runs(reg.load(runs["ids"]["a"]),
+                    reg.load(runs["ids"]["b"]))
+    assert rep["verdict"] == "clean"
+    assert rep["mode_drift"] == []
+    counts = rep["parity"]["counts"]
+    assert counts["distinct_states"]["equal"]
+    assert rep["parity"]["level_sizes_equal"] is True
+    assert rep["run_a"]["run_id"] == runs["ids"]["a"]
+    # span deltas cover the phases both runs recorded
+    assert rep["spans"], "no span deltas on instrumented runs"
+
+
+def test_diff_mismatch_on_depth_gate(runs):
+    reg = RunRegistry(runs["reg_dir"])
+    rep = diff_runs(reg.load(runs["ids"]["a"]),
+                    reg.load(runs["ids"]["gated"]))
+    assert rep["verdict"] == "mismatch"
+    assert not rep["parity"]["counts"]["distinct_states"]["equal"]
+    assert rep["parity"]["level_sizes_equal"] is False
+
+
+def test_diff_mode_drift_named(runs):
+    """Counts equal under different mode flags is the repo's A/B shape
+    — named drift, not a mismatch (synthesized record: the flags are
+    pure counter values, no second compile needed)."""
+    reg = RunRegistry(runs["reg_dir"])
+    a = reg.load(runs["ids"]["a"])
+    d = json.loads(json.dumps(a))
+    d["counters"]["delta_matmul"] = 1 - int(
+        a["counters"]["delta_matmul"])
+    rep = diff_runs(a, d)
+    assert rep["verdict"] == "mode_drift"
+    assert rep["mode_drift"] == ["delta_matmul"]
+
+
+def test_obs_cli_exit_codes(runs, capsys):
+    from raft_tla_tpu import cli
+    reg, ids = runs["reg_dir"], runs["ids"]
+    assert cli.main(["obs", "ls", "--registry", reg]) == 0
+    out = capsys.readouterr().out
+    for rid in ids.values():
+        assert rid in out
+    assert cli.main(["obs", "show", "--registry", reg, "last"]) == 0
+    capsys.readouterr()
+    # diff: clean pair 0, depth-gated pair 1, unresolvable token 2
+    assert cli.main(["obs", "diff", "--registry", reg,
+                     ids["a"], ids["b"]]) == 0
+    assert json.loads(capsys.readouterr().out)["verdict"] == "clean"
+    assert cli.main(["obs", "diff", "--registry", reg,
+                     ids["a"], ids["gated"]]) == 1
+    capsys.readouterr()
+    assert cli.main(["obs", "diff", "--registry", reg,
+                     ids["a"], "nope"]) == 2
+    capsys.readouterr()
+    # regress: parity pair 0, injected mismatch 1, usage error 2
+    assert cli.main(["obs", "regress", "--registry", reg, ids["b"],
+                     "--against", ids["a"]]) == 0
+    capsys.readouterr()
+    assert cli.main(["obs", "regress", "--registry", reg,
+                     ids["gated"], "--against", ids["a"]]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert any("count mismatch" in f for f in rep["failures"])
+    assert cli.main(["obs", "regress", "--registry", reg,
+                     ids["b"]]) == 2
+    capsys.readouterr()
+
+
+def test_obs_cli_regress_baseline_file(runs, tmp_path, capsys):
+    """--baseline accepts a committed file: a registry record and a
+    BENCH-style rows map (--baseline-row)."""
+    from raft_tla_tpu import cli
+    reg, ids = runs["reg_dir"], runs["ids"]
+    rec = RunRegistry(reg).load(ids["a"])
+    base = str(tmp_path / "base.json")
+    with open(base, "w") as fh:
+        json.dump(rec, fh)
+    assert cli.main(["obs", "regress", "--registry", reg, ids["b"],
+                     "--baseline", base]) == 0
+    capsys.readouterr()
+    rows = str(tmp_path / "rows.json")
+    with open(rows, "w") as fh:
+        json.dump({"rows": {"on": rec}}, fh)
+    # rows map without --baseline-row: loud usage error
+    with pytest.raises(SystemExit):
+        cli.main(["obs", "regress", "--registry", reg, ids["b"],
+                  "--baseline", rows])
+    assert cli.main(["obs", "regress", "--registry", reg, ids["b"],
+                     "--baseline", rows, "--baseline-row", "on"]) == 0
+    capsys.readouterr()
+
+
+def test_resource_telemetry_fields(runs):
+    """The registry record, heartbeat and ledger all carry the
+    sampler's fields; the gated (compiling) run attributes its compile
+    wall-clock."""
+    reg = RunRegistry(runs["reg_dir"])
+    for tag in ("gated", "a", "b"):
+        rec = reg.load(runs["ids"][tag])
+        res = rec["resources"]
+        assert res["samples"] >= 1, (tag, res)
+        assert res["rss_peak_bytes"] > 0, (tag, res)
+        assert "compile_seconds" in res, (tag, res)
+        assert rec["backend"]["platform"], tag
+        assert rec["cmd"] == "check" and "ModelConfig" in rec["cfg"]
+        assert rec["spans"], tag
+        assert rec["counters"]["distinct_states"] == \
+            rec["distinct_states"]
+        assert rec["artifacts"]["ledger"].endswith(f"{tag}.jsonl")
+    # the compile happened under the gated run's obs
+    gated = reg.load(runs["ids"]["gated"])["resources"]
+    assert gated["compile_seconds"] > 0 and gated["compile_count"] >= 1
+    # heartbeat: run_id + final resource snapshot
+    hb = json.load(open(str(runs["dir"] / "a.hb.json")))
+    assert hb["run_id"] == runs["ids"]["a"]
+    assert hb["resources"]["rss_bytes"] > 0
+
+
+def test_ledger_rows_stamped_and_sequenced(runs):
+    """Every ledger row carries the registry's run id plus a strictly
+    increasing per-process seq; the meta row opens with the backend
+    fingerprint; a resource row precedes the dispatch rows; the FINAL
+    row stays the final dispatch record (the obs_smoke contract)."""
+    for tag in ("a", "b"):
+        rows = [json.loads(x)
+                for x in open(str(runs["dir"] / f"{tag}.jsonl"))]
+        assert all(r["run_id"] == runs["ids"][tag] for r in rows)
+        seqs = [r["seq"] for r in rows]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        kinds = [r["kind"] for r in rows]
+        assert kinds[0] == "meta"
+        assert rows[0]["backend"]["platform"]
+        assert "resource" in kinds
+        assert kinds[-1] in ("level", "burst"), kinds
+        res = next(r for r in rows if r["kind"] == "resource")
+        assert res["rss_bytes"] > 0

@@ -42,3 +42,8 @@ env JAX_PLATFORMS=cpu python tools/pjit_smoke.py
 # P-fold min-over-perms) count parity, raft block-product group AND
 # paxos full S_N, with the stats mode flag pinned 1/0
 env JAX_PLATFORMS=cpu python tools/sym_smoke.py
+# run-registry gate (ISSUE 17): three tiny --registry check runs, then
+# `cli obs diff/regress` must pass the identical pair (verdict clean,
+# rc 0) and CATCH an injected depth-gate count mismatch (rc 1), with
+# resource telemetry (RSS peak, compile seconds) on the records
+env JAX_PLATFORMS=cpu python tools/obs_report_smoke.py

@@ -17,6 +17,7 @@ Usage: python tools/deep_run.py CONFIG DEPTH [--spec raft|paxos]
        [--retries N] [--backoff S] [--chaos SPEC] [--host-table]
        [--partitions P] [--part-cap N] [--ledger FILE]
        [--heartbeat FILE] [--trace-timeline FILE] [--profile-dir DIR]
+       [--registry DIR]
 
 Fault tolerance (round 12, resil/): --retries N wraps the drive loop
 in the supervised runner — a dropped tunnel triggers backend reinit +
@@ -32,8 +33,10 @@ dispatch), --heartbeat atomically rewrites a watchdog file every
 dispatch (tools/watch.py tails both), --trace-timeline writes the
 host span timeline as Perfetto-loadable Chrome-trace JSON, and
 --profile-dir captures an XLA device trace with matching
-TraceAnnotation names.  The ROADMAP validation rounds should attach
---ledger/--heartbeat to every TPU run.
+TraceAnnotation names.  --registry DIR appends one queryable record
+per run (counters, span rollups, resource peaks, backend fingerprint)
+that ``cli obs ls/show/diff/regress`` reads — the ROADMAP validation
+rounds should attach --ledger/--heartbeat/--registry to every TPU run.
 
 --host-table moves the visited set to fingerprint-prefix partitions in
 host RAM (engine/host_table), streamed through HBM per level — the
@@ -102,8 +105,8 @@ def main():
              "--ckpt-keep", "--retries", "--backoff", "--chaos",
              "--partitions", "--part-cap", "--burst-levels",
              "--ledger", "--heartbeat", "--trace-timeline",
-             "--profile-dir", "--dedup-kernel", "--fam-cap-density",
-             "--spec"}
+             "--profile-dir", "--registry", "--dedup-kernel",
+             "--fam-cap-density", "--spec"}
     bad = set(opts) - known
     if bad or len(args) % 2:
         # fail loud: these depths cannot be cross-checked by any other
@@ -219,6 +222,8 @@ def main():
                      heartbeat=opts.get("--heartbeat"),
                      timeline=opts.get("--trace-timeline"),
                      profile_dir=opts.get("--profile-dir"),
+                     registry=opts.get("--registry"),
+                     run_info={"cmd": "deep_run", "cfg": repr(cfg)},
                      meta={"spec": eng.ir.name,
                            "ir_fingerprint": eng.ir.fingerprint()})
     obs.start()
@@ -263,7 +268,9 @@ def main():
         obs.finish(status="failed")
         raise
     secs = time.perf_counter() - t0
-    obs.finish(depth=int(r.depth), states=int(r.distinct_states))
+    obs.finish(depth=int(r.depth), states=int(r.distinct_states),
+               counters=r.metrics.as_dict(),
+               level_sizes=[int(x) for x in r.level_sizes])
     rec = {
         "engine": type(eng).__name__,
         "spec": eng.ir.name,

@@ -1,0 +1,155 @@
+"""Run-registry / regression-verdict smoke validation (ISSUE 17;
+tools/ci_smoke.sh step).
+
+Three tiny CLI check runs record into one ``--registry`` directory:
+two identical (A, B) and one with an injected depth-gate mismatch (C,
+``--max-depth 3`` vs 6).  Then the query surface is validated end to
+end:
+
+- ``cli obs diff A B`` emits a machine-readable ``verdict: clean``
+  (count + level-size parity, no mode-flag drift) and exits 0;
+- ``cli obs diff A C`` names the count mismatch and exits 1;
+- ``cli obs regress B --against A`` exits 0 (the parity pair passes);
+- ``cli obs regress C --against A`` exits 1 (the injected mismatch is
+  CAUGHT — the acceptance contract: a regression gate that cannot
+  fail is not a gate);
+- both parity runs' registry records carry the resource telemetry
+  (host RSS peak, compile seconds; device memory only where the
+  backend reports it — XLA:CPU does not) and the backend fingerprint,
+  and the ledger/heartbeat artifacts cross-link by the same run id.
+
+Exits 0 on success, 1 with a message on any violation.  CPU-only and
+reference-free (repo-local configs/ twin), like the other smokes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fail(msg):
+    print(f"obs_report_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_cli(args, env):
+    proc = subprocess.run([sys.executable, "-m", "raft_tla_tpu"]
+                          + args, env=env, cwd=_REPO,
+                          capture_output=True, text=True)
+    return proc
+
+
+def check_run(reg, td, tag, max_depth, env):
+    """One tiny CLI check into the registry; returns its new run id
+    (the registry file that appeared)."""
+    before = set(os.listdir(reg)) if os.path.isdir(reg) else set()
+    proc = run_cli([
+        "check",
+        os.path.join(_REPO, "configs", "tlc_membership", "raft.cfg"),
+        "--servers", "2", "--init-servers", "2",
+        "--max-log-length", "1", "--max-timeouts", "1",
+        "--max-client-requests", "1", "--max-depth", str(max_depth),
+        "--registry", reg,
+        "--ledger", os.path.join(td, f"{tag}.jsonl"),
+        "--heartbeat", os.path.join(td, f"{tag}.hb.json"),
+    ], env)
+    if proc.returncode != 0:
+        fail(f"check run {tag} failed rc={proc.returncode}:\n"
+             f"{proc.stderr}")
+    new = [n for n in set(os.listdir(reg)) - before
+           if n.endswith(".json")]
+    if len(new) != 1:
+        fail(f"run {tag}: expected exactly one new registry record, "
+             f"got {sorted(new)}")
+    return new[0][:-len(".json")]
+
+
+def main():
+    td = tempfile.mkdtemp(prefix="obs_report_smoke_")
+    reg = os.path.join(td, "registry")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    a = check_run(reg, td, "a", 6, env)
+    b = check_run(reg, td, "b", 6, env)
+    c = check_run(reg, td, "c", 3, env)   # injected depth-gate drift
+
+    # -- diff: parity pair clean, gated pair a named mismatch -----------
+    proc = run_cli(["obs", "diff", "--registry", reg, a, b], env)
+    if proc.returncode != 0:
+        fail(f"diff A B rc={proc.returncode} (want 0):\n{proc.stderr}")
+    rep = json.loads(proc.stdout)
+    if rep.get("verdict") != "clean":
+        fail(f"diff A B verdict {rep.get('verdict')!r} != 'clean': "
+             f"{rep.get('parity')}")
+    if rep.get("mode_drift"):
+        fail(f"identical runs report mode drift: {rep['mode_drift']}")
+
+    proc = run_cli(["obs", "diff", "--registry", reg, a, c], env)
+    if proc.returncode != 1:
+        fail(f"diff A C rc={proc.returncode} (want 1 — the depth-"
+             f"gated run counts fewer states):\n{proc.stdout}")
+    rep = json.loads(proc.stdout)
+    if rep.get("verdict") != "mismatch":
+        fail(f"diff A C verdict {rep.get('verdict')!r} != 'mismatch'")
+    ds = rep.get("parity", {}).get("counts", {}).get("distinct_states")
+    if not ds or ds.get("equal"):
+        fail(f"diff A C does not name the distinct_states mismatch: "
+             f"{rep.get('parity')}")
+
+    # -- regress: the parity pair passes, the injected mismatch trips ---
+    proc = run_cli(["obs", "regress", "--registry", reg, b,
+                    "--against", a], env)
+    if proc.returncode != 0:
+        fail(f"regress B vs A rc={proc.returncode} (want 0):\n"
+             f"{proc.stdout}\n{proc.stderr}")
+    proc = run_cli(["obs", "regress", "--registry", reg, c,
+                    "--against", a], env)
+    if proc.returncode != 1:
+        fail(f"regress C vs A rc={proc.returncode} (want 1 — the "
+             f"gate must CATCH the injected mismatch):\n{proc.stdout}")
+    rep = json.loads(proc.stdout)
+    if not any("mismatch" in f for f in rep.get("failures", [])):
+        fail(f"regress C vs A names no mismatch: {rep}")
+
+    # -- resource + identity fields on the parity records ---------------
+    for tag, rid in (("a", a), ("b", b)):
+        rec = json.load(open(os.path.join(reg, rid + ".json")))
+        res = rec.get("resources") or {}
+        if not res.get("rss_peak_bytes", 0) > 0:
+            fail(f"run {tag}: no host RSS peak in resources: {res}")
+        if "compile_seconds" not in res:
+            fail(f"run {tag}: no compile_seconds in resources: {res}")
+        # device memory appears only where the backend reports it
+        # (XLA:CPU does not) — present means positive, absent is fine
+        if "device_peak_bytes_in_use" in res \
+                and not res["device_peak_bytes_in_use"] > 0:
+            fail(f"run {tag}: zero device peak reported: {res}")
+        if not (rec.get("backend") or {}).get("platform"):
+            fail(f"run {tag}: no backend fingerprint: {rec.get('backend')}")
+        # artifacts cross-link by run id: every ledger row and the
+        # heartbeat carry the record's id
+        rows = [json.loads(x)
+                for x in open(os.path.join(td, f"{tag}.jsonl"))]
+        if not rows or any(r.get("run_id") != rid for r in rows):
+            fail(f"run {tag}: ledger rows not stamped with {rid}")
+        seqs = [r.get("seq") for r in rows]
+        if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+            fail(f"run {tag}: ledger seq not strictly increasing: "
+                 f"{seqs}")
+        hb = json.load(open(os.path.join(td, f"{tag}.hb.json")))
+        if hb.get("run_id") != rid:
+            fail(f"run {tag}: heartbeat run_id {hb.get('run_id')} != "
+                 f"{rid}")
+
+    print(f"obs_report_smoke: ok — parity pair clean, injected "
+          f"depth-gate mismatch caught by diff(rc 1) and regress"
+          f"(rc 1), resource + identity fields present ({td})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

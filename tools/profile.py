@@ -284,10 +284,11 @@ def main():
         (mode_steady if mode == "steady" else mode_phases)(opts, rec)
     finally:
         rec.close()
-    tot = rec.totals()
-    print("span totals: " + "  ".join(
-        f"{nm}={t['seconds']:.2f}s/{t['count']}"
-        for nm, t in tot.items()), flush=True)
+    # the ONE span-rollup rendering lives in obs/report.py (ISSUE 17);
+    # `cli obs show/diff` print the same shape
+    from raft_tla_tpu.obs.report import format_span_totals
+    print("span totals: " + format_span_totals(rec.totals()),
+          flush=True)
     return 0
 
 

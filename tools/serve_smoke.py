@@ -125,7 +125,9 @@ def main():
         assert a["distinct_states"] == b["distinct_states"] and \
             a["level_sizes"] == b["level_sizes"], (a, b)
     k2 = ledger_kinds(os.path.join(tmp, "l2"))
-    assert set(k2) - {"tenant"} == {"job"}, \
+    # meta (run start) and resource (sampler) rows are bookkeeping,
+    # not dispatches — the contract is zero DEVICE dispatch kinds
+    assert set(k2) - {"tenant", "meta", "resource"} == {"job"}, \
         f"cached re-run must dispatch nothing, ledger kinds: {k2}"
     print("serve_smoke: OK (2 jobs batched; re-run 100% cache, "
           "0 device dispatches)")

@@ -11,9 +11,15 @@ throughput) and prints one status line per interval:
 
 A heartbeat older than ``--stale`` seconds (default 300 — a slow level
 on the tunneled runtime can legitimately take minutes) or a dead pid
-flags the run STALLED/DEAD.  A supervised run (``--retries``) in its
-backoff window renders RETRYING with the attempt counters instead —
-alive, not stalled — and a parked batch job shows status ``parked``.
+flags the run STALLED/DEAD.  Stall detection is also CADENCE-AWARE
+(ISSUE 17): once a run has beaten enough times to establish its own
+rhythm (>= 5 beats), a heartbeat older than ``--cadence-factor`` times
+the observed inter-beat cadence flags ``STALLED?`` even before the
+absolute ``--stale`` bound — a dropped TPU tunnel on a fast-beating
+run no longer looks identical to one long level.  A supervised run
+(``--retries``) in its backoff window renders RETRYING with the
+attempt counters instead — alive, not stalled — and a parked batch
+job shows status ``parked``.
 
 Multi-job mode: a batch heartbeat (``cli batch`` — the serving layer)
 carries a per-job status map; one extra line renders per job:
@@ -32,7 +38,7 @@ cache counters render as dashboard lines after the job map:
 
 Usage:
   python tools/watch.py HEARTBEAT [--ledger FILE] [--interval SEC]
-                        [--stale SEC] [--once]
+                        [--stale SEC] [--cadence-factor N] [--once]
 
 ``--once`` prints a single line and exits 0 (healthy), 1 (stalled or
 dead), 2 (no heartbeat yet) — the shape a cron watchdog wants.
@@ -59,19 +65,37 @@ def pid_alive(pid: int) -> bool:
     return True
 
 
+# the per-dispatch record kinds a throughput estimate may difference
+# (meta/resource/retry/job/... rows carry no cumulative state counts)
+_DISPATCH_KINDS = ("level", "burst", "sim", "batch")
+
+
 def last_ledger_records(path, n=2):
-    """The last n parseable records of a JSONL ledger (the final line
-    can be mid-write — skip anything that does not parse)."""
+    """The last n parseable DISPATCH records of a JSONL ledger (the
+    final line can be mid-write — skip anything that does not parse).
+
+    Interleaved/resumed runs demultiplex by the run-id + seq keys
+    (ISSUE 17): only records of the newest run id are considered, in
+    seq order, so a ledger a resumed run appended to never yields a
+    rate computed across two different runs.  Pre-ISSUE-17 rows carry
+    neither key and still parse (one unkeyed stream)."""
     recs = []
     try:
         with open(path) as fh:
             for line in fh:
                 try:
-                    recs.append(json.loads(line))
+                    rec = json.loads(line)
                 except ValueError:
                     continue
+                if rec.get("kind") in _DISPATCH_KINDS:
+                    recs.append(rec)
     except OSError:
         return []
+    if not recs:
+        return []
+    live = recs[-1].get("run_id")
+    recs = [r for r in recs if r.get("run_id") == live]
+    recs.sort(key=lambda r: r.get("seq", 0))
     return recs[-n:]
 
 
@@ -129,7 +153,30 @@ def slo_lines(hb):
     return out
 
 
-def status_line(hb_path, ledger_path, stale_s):
+# a run must beat this many times before its own cadence is trusted
+# for stall detection (too few samples and one slow early level —
+# compile included — would poison the estimate)
+MIN_CADENCE_BEATS = 5
+# never flag on cadence alone under this age: sub-second-cadence
+# micro runs would flap on ordinary scheduler hiccups
+CADENCE_FLOOR_S = 30.0
+
+
+def observed_cadence(hb):
+    """Mean inter-beat seconds of this heartbeat's own history, or
+    None before MIN_CADENCE_BEATS (the heartbeat carries started_ts /
+    last_dispatch_ts / beats, so the cadence needs no extra state)."""
+    beats = int(hb.get("beats", 0))
+    if beats < MIN_CADENCE_BEATS:
+        return None
+    span = hb["last_dispatch_ts"] - hb.get("started_ts",
+                                           hb["last_dispatch_ts"])
+    if span <= 0:
+        return None
+    return span / (beats - 1)
+
+
+def status_line(hb_path, ledger_path, stale_s, cadence_factor=8.0):
     """(line, exit_code): 0 healthy, 1 stalled/dead, 2 unreadable.
     Batch heartbeats append one line per job (job_lines)."""
     try:
@@ -158,6 +205,10 @@ def status_line(hb_path, ledger_path, stale_s):
     if rate is not None:
         parts.append(f"{rate:,.0f}/s")
     parts.append(f"last dispatch {age:.0f}s ago")
+    cadence = observed_cadence(hb)
+    cadence_limit = None
+    if cadence is not None and cadence_factor:
+        cadence_limit = max(cadence * cadence_factor, CADENCE_FLOOR_S)
     code = 0
     if finished:
         parts.append("FINISHED")
@@ -179,6 +230,15 @@ def status_line(hb_path, ledger_path, stale_s):
         parts.append(f"pid {hb['pid']} alive but STALLED? "
                      f"(> {stale_s:.0f}s since last dispatch)")
         code = 1
+    elif cadence_limit is not None and age > cadence_limit:
+        # the run's own rhythm says this gap is abnormal even though
+        # the absolute --stale bound has not yet tripped: a dropped
+        # tunnel on a fast-beating run surfaces in minutes, not hours
+        parts.append(
+            f"pid {hb['pid']} alive but STALLED? ({age:.0f}s "
+            f"> {cadence_factor:.0f}x observed cadence "
+            f"{cadence:.1f}s/beat over {hb.get('beats', 0)} beats)")
+        code = 1
     else:
         parts.append(f"pid {hb['pid']} alive")
     line = "  ".join(parts)
@@ -198,19 +258,21 @@ def main(argv=None):
     if once:
         args.remove("--once")
     opts = dict(zip(args[::2], args[1::2]))
-    bad = set(opts) - {"--ledger", "--interval", "--stale"}
+    bad = set(opts) - {"--ledger", "--interval", "--stale",
+                       "--cadence-factor"}
     if bad or len(args) % 2:
         raise SystemExit(f"unknown/incomplete options: "
                          f"{sorted(bad) or args[-1:]}")
     ledger = opts.get("--ledger")
     interval = float(opts.get("--interval", 5))
     stale = float(opts.get("--stale", 300))
+    factor = float(opts.get("--cadence-factor", 8))
     if once:
-        line, code = status_line(hb_path, ledger, stale)
+        line, code = status_line(hb_path, ledger, stale, factor)
         print(line)
         return code
     while True:
-        line, code = status_line(hb_path, ledger, stale)
+        line, code = status_line(hb_path, ledger, stale, factor)
         print(time.strftime("%H:%M:%S") + "  " + line, flush=True)
         if "FINISHED" in line:
             return 0
