@@ -898,6 +898,79 @@ def cmd_batch(args):
     return 1 if n_viol else 0
 
 
+def cmd_serve(args):
+    """The persistent checking daemon (serve/daemon): watch a spool
+    directory (and/or tail a JSONL stream) for job submissions, drain
+    claimed jobs through the shared wave scheduler, and write one
+    atomic result JSON + done/ marker per submission.  Runs until
+    SIGTERM/SIGINT (graceful drain, exit 0) or --max-idle-polls.
+    Exit 0 = drained cleanly, 2 = usage error, 3 = a serve cycle
+    exhausted its retries (the supervisor's restart signal)."""
+    from .serve import Daemon, ResultCache
+    if args.poll <= 0:
+        print(f"--poll must be positive (got {args.poll})",
+              file=sys.stderr)
+        return 2
+    if args.grace < 0:
+        print(f"--grace must be >= 0 (got {args.grace})",
+              file=sys.stderr)
+        return 2
+    if args.max_idle_polls is not None and args.max_idle_polls < 1:
+        print(f"--max-idle-polls must be >= 1 "
+              f"(got {args.max_idle_polls})", file=sys.stderr)
+        return 2
+    if args.wave_yield is not None and args.wave_yield < 1:
+        print(f"--wave-yield must be >= 1 (got {args.wave_yield})",
+              file=sys.stderr)
+        return 2
+    if args.cache_max_bytes is not None and args.cache_max_bytes <= 0:
+        print(f"--cache-max-bytes must be positive (got "
+              f"{args.cache_max_bytes}); omit it for an unbounded "
+              "cache", file=sys.stderr)
+        return 2
+    if args.executable_cache_max_bytes is not None:
+        if args.executable_cache_max_bytes <= 0:
+            print(f"--executable-cache-max-bytes must be positive "
+                  f"(got {args.executable_cache_max_bytes}); omit it "
+                  "for an unbounded cache", file=sys.stderr)
+            return 2
+        if not args.executable_cache:
+            print("--executable-cache-max-bytes bounds the on-disk "
+                  "executable cache: add --executable-cache",
+                  file=sys.stderr)
+            return 2
+    err = _check_retry_flags(args) or _install_chaos(args)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+    # the daemon is restart-proof BY DEFAULT: result cache and wave
+    # state live under the spool unless pointed elsewhere
+    cache_dir = args.cache_dir or os.path.join(args.spool, "cache")
+    wave_dir = args.wave_state or os.path.join(args.spool, "waves")
+    cache = ResultCache(cache_dir, max_bytes=args.cache_max_bytes)
+    exec_cache = None
+    if args.executable_cache:
+        from .serve.exec_cache import ExecCache
+        exec_cache = ExecCache(
+            args.executable_cache,
+            max_bytes=args.executable_cache_max_bytes)
+    obs = _build_obs(args, cmd="serve")
+    obs.start()
+    daemon = Daemon(
+        args.spool, cache=cache, wave_state=wave_dir,
+        exec_cache=exec_cache, obs=obs, poll_s=args.poll,
+        wave_yield=args.wave_yield,
+        bucket_overrides=({"sym_canon": args.sym_canon}
+                          if args.sym_canon != "auto" else None),
+        retries=args.retries, backoff=args.backoff,
+        max_idle_polls=args.max_idle_polls, stream=args.stream,
+        grace_s=args.grace, verbose=args.verbose)
+    daemon.install_signals()
+    # daemon.run owns obs.finish (the drain epilogue must run on
+    # every exit path, with the daemon's own counters)
+    return daemon.run()
+
+
 def _load_baseline_file(path, row):
     """A committed baseline for ``obs regress``: a --stats-json
     payload, a bench headline object, a registry record, or a BENCH
@@ -1403,6 +1476,87 @@ def main(argv=None):
     _add_obs_flags(pb)
     pb.set_defaults(fn=cmd_batch)
 
+    pd = sub.add_parser(
+        "serve",
+        help="persistent checking daemon: watch a spool directory "
+             "(and/or tail a JSONL stream) for job files, claim them "
+             "atomically, drain them through the shared wave "
+             "scheduler, and write one atomic result JSON + done/ "
+             "marker per job; SIGTERM drains gracefully (README "
+             "'Daemon service' documents the spool protocol)")
+    pd.add_argument("--spool", required=True, metavar="DIR",
+                    help="spool root: incoming/ claimed/ rejected/ "
+                         "results/ done/ are created under it; "
+                         "clients write-then-rename one JSON job "
+                         "object per file (trailing newline) into "
+                         "incoming/")
+    pd.add_argument("--stream", default=None, metavar="FILE",
+                    help="also tail this append-only JSONL job "
+                         "stream: each complete appended line "
+                         "materializes as a spool submission "
+                         "(stream-<n>); the consumed offset persists "
+                         "across restarts")
+    pd.add_argument("--poll", type=float, default=0.5, metavar="SEC",
+                    help="spool poll interval while idle "
+                         "(default 0.5)")
+    pd.add_argument("--grace", type=float, default=5.0, metavar="SEC",
+                    help="seconds an incomplete submission (no "
+                         "trailing newline — a writer mid-write) may "
+                         "sit in incoming/ before it quarantines as "
+                         "torn (default 5)")
+    pd.add_argument("--max-idle-polls", type=int, default=None,
+                    metavar="N",
+                    help="drain and exit 0 after N consecutive empty "
+                         "polls (default: run until SIGTERM; CI "
+                         "smokes use this for bounded runs)")
+    pd.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="result cache directory (default: "
+                         "SPOOL/cache) — duplicate submissions are "
+                         "answered from it with zero device "
+                         "dispatches")
+    pd.add_argument("--cache-max-bytes", type=int, default=None,
+                    metavar="N",
+                    help="LRU-by-bytes result-cache bound (see "
+                         "batch --cache-max-bytes)")
+    pd.add_argument("--executable-cache", default=None, metavar="DIR",
+                    help="persistent AOT executable cache: a warm "
+                         "daemon restart performs ZERO bucket "
+                         "compiles (see batch --executable-cache)")
+    pd.add_argument("--executable-cache-max-bytes", type=int,
+                    default=None, metavar="N",
+                    help="LRU-by-bytes bound on the executable cache "
+                         "(see batch --executable-cache-max-bytes)")
+    pd.add_argument("--wave-state", default=None, metavar="DIR",
+                    help="wave-state directory (default: SPOOL/waves) "
+                         "— live jobs persist their carry at every "
+                         "wave boundary, so a killed daemon resumes "
+                         "stragglers mid-BFS bit-exact on restart")
+    pd.add_argument("--wave-yield", type=int, default=None,
+                    metavar="N",
+                    help="fairness: a wave yields its lanes after N "
+                         "batched device calls while other claimed "
+                         "jobs wait (higher Job priority runs first)")
+    pd.add_argument("--retries", type=int, default=0, metavar="N",
+                    help="re-run a failed serve cycle up to N times "
+                         "with bounded exponential backoff "
+                         "(incremental via the result cache + wave "
+                         "state); exhaustion exits 3")
+    pd.add_argument("--backoff", type=float, default=2.0, metavar="S",
+                    help="base backoff seconds for --retries")
+    pd.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="deterministic fault injection (resil/"
+                         "chaos); 'intake' faults the spool scan, "
+                         "'wave_kill:at=1' is the deterministic "
+                         "SIGKILL stand-in the daemon smoke uses")
+    pd.add_argument("--sym-canon",
+                    choices=("auto", "sort", "minperm"),
+                    default="auto",
+                    help="symmetry canonicalization for every bucket "
+                         "engine (see batch --sym-canon)")
+    pd.add_argument("--verbose", "-v", action="store_true")
+    _add_obs_flags(pd)
+    pd.set_defaults(fn=cmd_serve)
+
     po = sub.add_parser(
         "obs",
         help="query the run registry: ls (run table), show RUN, "
@@ -1422,10 +1576,11 @@ def main(argv=None):
                      help="only runs of this spec frontend")
     ols.add_argument("--cmd", dest="cmd_filter", default=None,
                      help="only runs of this command (check/simulate/"
-                          "batch/deep_run/bench)")
+                          "batch/serve/deep_run/bench)")
     ols.add_argument("--status", default=None,
                      help="only runs with this exit status "
-                          "(finished/failed)")
+                          "(finished/failed, or a daemon's "
+                          "done/draining)")
 
     oshow = osub.add_parser(
         "show", help="one run's full record (counters, span rollups, "

@@ -1492,16 +1492,32 @@ class Engine:
             return nst, out
         return jax.vmap(one)(jst, lv_left, st_cap)
 
-    def burst_batched_fn(self):
+    def burst_batched_fn(self, donate: bool = True):
         """The jitted job-axis burst entry point (lazy: solo checks
         never pay for it).  The serving layer AOT-compiles it per
         (bucket, padded job count) via ``.lower(...).compile()`` so the
-        compile lands in one attributable span."""
+        compile lands in one attributable span.
+
+        ``donate=False`` compiles WITHOUT donating the carry.  Carry
+        donation bakes input->output buffer aliasing into the XLA
+        executable, and on this jax version (0.4.37) an executable
+        deserialized in a DIFFERENT process loses the jax-side half of
+        that contract: the re-fed carry comes back silently corrupted
+        (the harvest stats stay right, so nothing crashes — the wave
+        state persisted at the next boundary is garbage and a resumed
+        run goes wrong).  The serving layer therefore compiles the
+        donation-free variant whenever a persistent executable cache
+        is in play, trading one carry's worth of device memory for a
+        program that round-trips serialization exactly
+        (tools/daemon_smoke.py pins the kill->restart path warm)."""
         if self._bat_jit is None:
             _register_barrier_batching()
-            self._bat_jit = jax.jit(self._batched_burst_impl,
-                                    donate_argnums=0)
-        return self._bat_jit
+            self._bat_jit = {
+                True: jax.jit(self._batched_burst_impl,
+                              donate_argnums=0),
+                False: jax.jit(self._batched_burst_impl),
+            }
+        return self._bat_jit[bool(donate)]
 
     def _burst_impl(self, carry, fam_caps, levels_left, states_cap):
         """Classic-carry wrapper around _burst_core: slice the ring out

@@ -92,6 +92,7 @@ class Obs:
         self._n_dispatch = 0
         self._last_jobs = None
         self._last_slo = None
+        self._last_daemon = None
         self._last_metrics: Optional[Dict] = None
         # one id per run, stamped into every ledger row (RunLedger's
         # stamp), the heartbeat, and the registry record, so
@@ -206,6 +207,11 @@ class Obs:
                 extra["slo"] = dict(slo)
             if res_snap is not None:
                 extra["resources"] = res_snap
+            if self._last_daemon is not None:
+                # a daemon's in-wave dispatch beats keep the daemon
+                # block visible — watch's daemon view never flickers
+                # away while a wave is running
+                extra["daemon"] = self._last_daemon
             self.heartbeat.beat(depth=depth, states=states,
                                 extra=extra or None)
 
@@ -217,6 +223,24 @@ class Obs:
         self._last_jobs = dict(jobs)
         if slo is not None:
             self._last_slo = dict(slo)
+
+    def daemon_beat(self, *, status: str, stats: Dict):
+        """One daemon lifecycle beat (serve/daemon): heartbeat status
+        ``idle|serving|draining`` plus the ``daemon`` block (queue
+        depths, cycle/done/rejected counters, per-tenant rollups)
+        tools/watch.py renders as the daemon view.  The block is also
+        remembered so every subsequent dispatch beat carries it."""
+        self._last_daemon = dict(stats)
+        if self.heartbeat is None:
+            return
+        extra = {"daemon": self._last_daemon}
+        if self._last_jobs is not None:
+            extra["jobs"] = self._last_jobs
+        if self._last_slo is not None:
+            extra["slo"] = self._last_slo
+        self.heartbeat.beat(depth=self.heartbeat.last_depth,
+                            states=self.heartbeat.last_states,
+                            status=status, extra=extra)
 
     def retry(self, *, attempt: int, max_attempts: int, wait_s: float,
               error):
@@ -265,7 +289,15 @@ class Obs:
     def finish(self, depth: Optional[int] = None,
                states: Optional[int] = None, status: str = "finished",
                counters: Optional[Dict] = None,
-               level_sizes=None):
+               level_sizes=None, extra: Optional[Dict] = None):
+        """``extra`` (the daemon's drain epilogue): merged into both
+        the final heartbeat's extra payload and the registry record's
+        top level — e.g. ``{"daemon": {...}, "drain_reason": ...}``.
+        A ``status`` key in it overrides the REGISTRY record's status
+        only (the daemon records ``draining`` when it exits with work
+        still parked) — the heartbeat keeps the ``status`` argument,
+        so watch always sees the terminal done/failed.  Callers own
+        the remaining key hygiene (don't shadow core fields)."""
         if self._profiling:
             import jax
             try:
@@ -290,7 +322,11 @@ class Obs:
                        ({"slo": self._last_slo}
                         if self._last_slo is not None else {}) |
                        ({"resources": self._resources.sample()}
-                        if self._resources is not None else {})) or
+                        if self._resources is not None else {}) |
+                       ({"daemon": self._last_daemon}
+                        if self._last_daemon is not None else {}) |
+                       ({k: v for k, v in extra.items()
+                         if k != "status"} if extra else {})) or
                 None)
         if self.registry is not None:
             # ONE atomic schema-versioned record per run — the
@@ -326,6 +362,8 @@ class Obs:
                      getattr(self.heartbeat, "path", None)),
                     ("timeline", getattr(self.spans, "path", None)),
                     ("profile_dir", self.profile_dir)) if v}
+            if extra:
+                rec.update(extra)
             self.registry.append(rec)
         if self.ledger is not None:
             self.ledger.close()

@@ -32,8 +32,12 @@ Sites (each names one injection point in the engines)::
     wave_kill   raised at a serve wave boundary AFTER the per-job wave
                 state persists — the deterministic stand-in for
                 SIGKILLing a ``cli batch`` run mid-wave
+    intake      raised in the daemon's spool scan (serve/intake)
+                BEFORE a submission's claim rename — a disk/NFS error
+                during intake; the submission stays in incoming/ and
+                the next poll re-claims it
 
-``dispatch``/``archive``/``host_table``/``wave_kill`` RAISE
+``dispatch``/``archive``/``host_table``/``wave_kill``/``intake`` RAISE
 ``InjectedFault`` (the supervised runner catches and recovers);
 ``ckpt_torn``/``ckpt_corrupt`` silently damage the just-published
 checkpoint bytes so the NEXT resume exercises the chain fallback.
@@ -50,7 +54,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 KNOWN_SITES = ("dispatch", "ckpt_torn", "ckpt_corrupt", "archive",
-               "host_table", "wave_kill")
+               "host_table", "wave_kill", "intake")
 
 
 class ChaosSpecError(ValueError):

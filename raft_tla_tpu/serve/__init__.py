@@ -1,21 +1,27 @@
-"""Batched multi-tenant serving layer (ROADMAP 2b).
+"""Batched multi-tenant serving layer (ROADMAP 2b + item 1).
 
 Takes a list of (spec, config, engine-options) jobs, groups them into
 shape buckets, runs each bucket as ONE device program with a leading
 job axis (serve/batch), and short-circuits repeat jobs through a
-fingerprint-keyed result cache (serve/cache).  ``cli batch`` is the
-command-line front door; serve/jobs defines the job objects and the
-JSONL format.
+fingerprint-keyed result cache (serve/cache).  The driver loop lives
+in serve/scheduler (``WaveScheduler``) — ``cli batch`` drains a job
+list through it once, and the persistent daemon (serve/daemon +
+serve/intake, ``cli serve``) runs it cycle after cycle over a spool
+directory.  serve/jobs defines the job objects and the JSONL format.
 """
 
 from .batch import (BatchReport, BucketEngine, JobOutcome, run_jobs)
 from .cache import ResultCache
+from .daemon import Daemon
 from .exec_cache import ExecCache
+from .intake import SpoolIntake, StreamTail, Submission
 from .jobs import Job, job_from_dict, load_jobs
+from .scheduler import WaveScheduler
 from .wavestate import WaveStateStore
 
 __all__ = [
-    "BatchReport", "BucketEngine", "ExecCache", "Job", "JobOutcome",
-    "ResultCache",
+    "BatchReport", "BucketEngine", "Daemon", "ExecCache", "Job",
+    "JobOutcome", "ResultCache", "SpoolIntake", "StreamTail",
+    "Submission", "WaveScheduler",
     "WaveStateStore", "job_from_dict", "load_jobs", "run_jobs",
 ]

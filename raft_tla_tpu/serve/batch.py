@@ -43,7 +43,6 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..obs import NULL_OBS
 from ..obs.metrics import check_stats
 from ..resil.chaos import chaos_point
 from ..spec import C_OVERFLOW, spec_of
@@ -273,9 +272,12 @@ class BatchReport:
 
     @property
     def summary(self) -> Dict:
+        # a drained serve() round leaves deferred outcomes as None —
+        # they carry no violations yet, by definition
         return {"kind": "batch_summary", **self.meta,
                 "violations": sum(int(o.report.get("violations", 0))
-                                  for o in self.outcomes)}
+                                  for o in self.outcomes
+                                  if o is not None)}
 
 
 # ---------------------------------------------------------------------------
@@ -407,7 +409,17 @@ class BucketEngine:
                           sym_canon=sym_canon)
         self.KB = self.eng._burst_width()
         self.VCAP = self.eng.VCAP
-        self._fn = self.eng.burst_batched_fn()
+        # Donation-free program whenever a persistent executable cache
+        # is in play: carry donation bakes input->output aliasing into
+        # the executable, and a serialize_executable round-trip loaded
+        # in a DIFFERENT process silently corrupts the donated carry
+        # outputs (stats stay right, the re-fed wave and the persisted
+        # wave state go wrong — daemon_smoke's warm-restart phase
+        # caught it).  The stored, loaded, and freshly-compiled
+        # programs must be the SAME program, so the choice is made
+        # once here and recorded in _exec_key_parts.
+        self._donate = exec_cache is None
+        self._fn = self.eng.burst_batched_fn(donate=self._donate)
         self._compiled = {}            # padded J -> AOT executable
         # constant-padding ceilings (round 13): with a serve_runtime
         # hook, every job's guard thresholds / family lane mask /
@@ -462,6 +474,9 @@ class BucketEngine:
             "incremental_fp": bool(eng.incremental_fp and
                                    eng.fpr.supports_incremental()),
             "rt_mode": self.rt_mode,
+            # donation mode is program identity: a donated executable
+            # must never be revived cross-process (see __init__)
+            "donate": self._donate,
         }
 
     # -- root admission ------------------------------------------------
@@ -607,7 +622,8 @@ class BucketEngine:
                  verbose: bool = False,
                  max_steps: Optional[int] = None,
                  wave_state: Optional[WaveStateStore] = None,
-                 slo_ctx: Optional[Dict] = None):
+                 slo_ctx: Optional[Dict] = None,
+                 stop=None):
         """Run up to a wave of jobs through the batched burst.
         Mutates the runs in place; jobs that bail are marked for the
         sequential fallback.  ``jobs_ctx`` is the batch-global per-job
@@ -620,7 +636,13 @@ class BucketEngine:
         waiting jobs; the driver re-enters parked runs in a later
         wave.  ``wave_state`` persists every live job's slice at each
         wave boundary, so a killed process resumes stragglers
-        mid-BFS."""
+        mid-BFS.
+
+        ``stop`` — graceful drain (serve/scheduler): a callable
+        checked at every wave step boundary, AFTER the wave-state
+        persist; when it returns true, still-live jobs park exactly as
+        a ``max_steps`` yield would, so the scheduler can defer them
+        with their carries safely on disk."""
         import jax.numpy as jnp
         eng = self.eng
         with obs.span("job_admit"):
@@ -728,7 +750,8 @@ class BucketEngine:
             # chaos site: the deterministic SIGKILL stand-in — fires
             # AFTER the persist, exactly like a kill at the boundary
             chaos_point("wave_kill")
-            if max_steps is not None and steps >= max_steps and \
+            if ((max_steps is not None and steps >= max_steps) or
+                    (stop is not None and stop())) and \
                     any(run.live for run, _ in admitted):
                 # preemption: park the stragglers' carry slices and
                 # yield the lanes to waiting jobs; the driver requeues
@@ -875,253 +898,17 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
     jobs from it on the next invocation, so a killed run continues
     finished jobs from the result cache and stragglers mid-BFS —
     bit-exact per job.  ``max_wave`` overrides the jobs-per-wave
-    ceiling (default 8; tests shrink it to force parking)."""
-    obs = obs if obs is not None else NULL_OBS
-    t0 = time.perf_counter()
-    if isinstance(wave_state, str):
-        wave_state = WaveStateStore(wave_state)
-    if isinstance(exec_cache, str):
-        from .exec_cache import ExecCache
-        exec_cache = ExecCache(exec_cache)
-    if wave_yield is not None and int(wave_yield) < 1:
-        raise ValueError(f"wave_yield must be >= 1 "
-                         f"(got {wave_yield})")
-    wave_cap = int(max_wave) if max_wave is not None else _MAX_WAVE
-    if wave_cap < 1:
-        raise ValueError(f"max_wave must be >= 1 (got {max_wave})")
-    meta = dict(jobs=len(jobs), cache_hits=0, buckets=0,
-                engines_compiled=0, batch_dispatches=0,
-                fallback_jobs=0, sequential=bool(sequential),
-                resumed_jobs=0, parked_waves=0)
-    slo = _SloTracker(len(jobs))
-    # labels key the heartbeat/watch job map and the report rows —
-    # empty ones get positional names, duplicates get #N suffixes so
-    # two same-labeled jobs never collapse into one watch line.  (The
-    # Job objects are relabeled in place: the outcome rows must carry
-    # the same names the heartbeat used.)
-    seen_labels: Dict[str, int] = {}
-    for i, job in enumerate(jobs):
-        if not job.label:
-            job.label = f"job{i}"
-        base = job.label
-        if base in seen_labels:
-            n = seen_labels[base]
-            while f"{base}#{n + 1}" in seen_labels:
-                n += 1
-            seen_labels[base] = n + 1
-            job.label = f"{base}#{n + 1}"
-        seen_labels.setdefault(job.label, 1)
-    outcomes: List[Optional[JobOutcome]] = [None] * len(jobs)
-    # the batch-global per-job status map every heartbeat carries
-    jobs_ctx: Dict[str, Dict] = {}
-    pending: List[int] = []
-    key_first: Dict[str, int] = {}
-    dup_of: Dict[int, int] = {}
-    for i, job in enumerate(jobs):
-        key = job.cache_key()
-        hit = cache.get(key) if cache is not None else None
-        if hit is not None:
-            meta["cache_hits"] += 1
-            outcomes[i] = JobOutcome._from_cache(job, hit)
-            jobs_ctx[job.label] = {
-                "depth": int(hit.get("depth", 0)),
-                "distinct": int(hit.get("distinct_states", 0)),
-                "status": "cache_hit"}
-            slo.job_done(0.0, 0.0)     # served instantly, honestly
-            _job_row(obs, outcomes[i])
-        elif key in key_first:
-            # two equal cache keys in one list are guaranteed the
-            # same result — compute once, answer the duplicate from
-            # the first job's outcome
-            dup_of[i] = key_first[key]
-        else:
-            key_first[key] = i
-            pending.append(i)
-    meta["deduped"] = len(dup_of)
-    solo: List[Tuple[int, str, Optional[str]]] = []
-    # wave-state resume: a pending job with a persisted carry enters
-    # its wave mid-BFS instead of from the roots (a killed run's
-    # stragglers; finished jobs were answered by the cache above)
-    restored: Dict[int, _JobRun] = {}
-    if wave_state is not None and not sequential:
-        for i in pending:
-            hit = wave_state.load(jobs[i].cache_key())
-            if hit is None:
-                continue
-            arrays, book = hit
-            restored[i] = _JobRun.from_wave_state(jobs[i], arrays,
-                                                  book)
-            meta["resumed_jobs"] += 1
-            if obs.ledger is not None:
-                obs.ledger.record({
-                    "kind": "wave_resume", "label": jobs[i].label,
-                    "depth": int(book["depth"]),
-                    "distinct": int(book["distinct"])})
-    if sequential:
-        solo = [(i, "done", None) for i in pending]
-    else:
-        buckets: Dict[tuple, list] = {}
-        for i in pending:
-            job = jobs[i]
-            ir = spec_of(job.cfg)
-            if job.seed_states is not None or \
-                    getattr(job.cfg, "prefix_pins", ()):
-                solo.append((i, "fallback",
-                             "seeded/prefix-pinned jobs run "
-                             "sequentially"))
-                continue
-            hook = ir.serve_bucket or _default_serve_bucket
-            ceiling, params = hook(job.cfg)
-            params = dict(params)
-            params.update(bucket_overrides or {})
-            bkey = (ir.name, ir.fingerprint(), repr(ceiling),
-                    tuple(sorted(params.items())))
-            buckets.setdefault(bkey, [ceiling, params, []])[2].append(i)
-        meta["buckets"] = len(buckets)
-        for bkey, (ceiling, params, idxs) in buckets.items():
-            from collections import deque
-            be = BucketEngine(ceiling, exec_cache=exec_cache, **params)
-            meta["engines_compiled"] += 1
-            # wave scheduling: priority first (stable on submission
-            # order), parked jobs requeue at the back — a long job
-            # yields its lane and continues in a later wave
-            queue = deque(sorted(
-                idxs, key=lambda i: (-jobs[i].priority, i)))
-            parked_runs: Dict[int, _JobRun] = {}
-            while queue:
-                wave = [queue.popleft()
-                        for _ in range(min(wave_cap, len(queue)))]
-                runs = []
-                for i in wave:
-                    run = parked_runs.pop(i, None)
-                    if run is None:
-                        # fresh AND wave-state-restored jobs stamp
-                        # their wait here (a restored run's _t0 is its
-                        # restore time in THIS process — its pre-kill
-                        # runtime is not recoverable, which the
-                        # row's "resumed from wave state" status_reason
-                        # flags for SLO consumers); parked runs keep
-                        # the wait stamped at their first entry
-                        run = restored.pop(i, None) or _JobRun(jobs[i])
-                        slo.job_entered(run)
-                    run.parked = False
-                    runs.append(run)
-                answered = sum(1 for o in outcomes if o is not None)
-                slo.set_queue_depth(len(jobs) - answered - len(runs))
-                be.run_wave(
-                    runs, obs, meta, jobs_ctx=jobs_ctx,
-                    verbose=verbose,
-                    max_steps=wave_yield if queue else None,
-                    wave_state=wave_state, slo_ctx=slo.snapshot)
-                if any(run.parked for run in runs):
-                    # one increment per wave that yielded, however
-                    # many jobs parked in it (the key counts WAVES)
-                    meta["parked_waves"] += 1
-                for i, run in zip(wave, runs):
-                    if run.parked:
-                        parked_runs[i] = run
-                        queue.append(i)
-                        continue
-                    if run.fallback:
-                        solo.append((i, "fallback",
-                                     run.fallback_reason))
-                        continue
-                    job = jobs[i]
-                    archives = ((run.parents, run.lanes, run.states,
-                                 be.eng.labels, be.eng.lay)
-                                if job.store_states else None)
-                    tracer = None
-                    outcome = JobOutcome(job, "done", res=run.res,
-                                         report=None,
-                                         archives=archives)
-                    if job.store_states:
-                        tracer = outcome.trace
-                    reason = ("resumed from wave state"
-                              if run.resumed else None)
-                    outcome.report = _build_report(job, run.res,
-                                                   "done",
-                                                   reason=reason,
-                                                   tracer=tracer)
-                    outcome.report["wait_s"] = round(run.wait_s, 3)
-                    outcome.report["service_s"] = round(
-                        run.res.seconds, 3)
-                    slo.job_done(run.wait_s, run.res.seconds)
-                    outcomes[i] = outcome
-    meta["fallback_jobs"] = sum(1 for _i, st, _r in solo
-                                if st == "fallback")
-    for i, status, reason in solo:
-        wait_s = time.perf_counter() - slo.t_submit
-        outcomes[i] = _run_solo(jobs[i], obs, meta, status, reason,
-                                sym_canon=(bucket_overrides or {})
-                                .get("sym_canon", "auto"))
-        res = outcomes[i].res
-        outcomes[i].report["wait_s"] = round(wait_s, 3)
-        outcomes[i].report["service_s"] = round(res.seconds, 3)
-        slo.job_done(wait_s, res.seconds)
-        jobs_ctx[jobs[i].label] = {"depth": int(res.depth),
-                                   "distinct":
-                                   int(res.distinct_states),
-                                   "status": status}
-    for i, src in dup_of.items():
-        payload = outcomes[src].cache_payload()
-        outcomes[i] = JobOutcome._from_cache(jobs[i], payload)
-        outcomes[i].report["status_reason"] = \
-            f"duplicate of job {jobs[src].label!r} in this batch"
-        jobs_ctx[jobs[i].label] = {
-            "depth": int(payload.get("depth", 0)),
-            "distinct": int(payload.get("distinct_states", 0)),
-            "status": "cache_hit"}
-        slo.job_done(0.0, 0.0)
-        _job_row(obs, outcomes[i])
-    slo.set_queue_depth(0)
-    if exec_cache is not None:
-        # honest executable-cache accounting into the summary, the
-        # heartbeat SLO snapshot and (below) the ledger
-        stats = exec_cache.stats()
-        meta.update(stats)
-        slo.snapshot["exec_cache"] = {
-            k: v for k, v in stats.items()
-            if not k.endswith("_reasons")}
-    if jobs_ctx:
-        # the final heartbeat carries the whole batch's job map + SLO
-        # snapshot, incl. cache hits and solo jobs that never rode a
-        # batched dispatch
-        obs.set_jobs(jobs_ctx, slo=slo.snapshot)
-    if obs.ledger is not None:
-        # per-tenant (spec) rollups: one kind="tenant" record per spec
-        # in the batch — the multi-tenant SLO summary a dashboard
-        # (tools/watch.py --ledger) reads without parsing job rows
-        tenants: Dict[str, Dict] = {}
-        for o in outcomes:
-            t = tenants.setdefault(o.job.ir.name, dict(
-                kind="tenant", spec=o.job.ir.name, jobs=0,
-                cache_hits=0, fallbacks=0, violations=0,
-                distinct_states=0, wait_s=0.0, service_s=0.0))
-            t["jobs"] += 1
-            t["cache_hits"] += int(o.status == "cache_hit")
-            t["fallbacks"] += int(o.status == "fallback")
-            t["violations"] += int(o.report.get("violations", 0))
-            t["distinct_states"] += int(
-                o.report.get("distinct_states", 0))
-            t["wait_s"] += float(o.report.get("wait_s", 0.0))
-            t["service_s"] += float(o.report.get("service_s", 0.0))
-        for t in tenants.values():
-            t["wait_s"] = round(t["wait_s"], 3)
-            t["service_s"] = round(t["service_s"], 3)
-            obs.ledger.record(t)
-        if exec_cache is not None:
-            obs.ledger.record({"kind": "exec_cache",
-                               **exec_cache.stats()})
-    for outcome in outcomes:
-        if outcome.status == "cache_hit":
-            continue
-        if cache is not None:
-            cache.put(outcome.report["cache_key"],
-                      outcome.cache_payload())
-        if wave_state is not None:
-            # the job is answered (and cached): retire its mid-BFS
-            # carry so a future invocation never resumes stale state
-            wave_state.drop(outcome.report["cache_key"])
-        _job_row(obs, outcome)
-    return BatchReport(outcomes, meta,
-                       seconds=time.perf_counter() - t0)
+    ceiling (default 8; tests shrink it to force parking).
+
+    This function is the one-shot wrapper over the shared
+    ``serve/scheduler.WaveScheduler`` core — the SAME driver loop the
+    persistent daemon (``cli serve``) runs every intake cycle.  All
+    scheduling logic (priority, yield/park, dedup, restore, fallback,
+    rollups) lives there; this module keeps the per-wave machinery
+    (``BucketEngine``) and the per-job bookkeeping it drives."""
+    from .scheduler import WaveScheduler
+    return WaveScheduler(
+        cache=cache, wave_state=wave_state, exec_cache=exec_cache,
+        bucket_overrides=bucket_overrides, wave_yield=wave_yield,
+        max_wave=max_wave).serve(
+        jobs, obs=obs, sequential=sequential, verbose=verbose)

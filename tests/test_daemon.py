@@ -1,0 +1,570 @@
+"""Persistent checking daemon (ISSUE 18): spool-dir intake protocol,
+stream tail, the shared wave-scheduler core's drain/defer/resume
+contract, the daemon cycle loop, and the watch daemon view.
+
+Budget: exactly two batched bucket compiles live here (one MICRO raft
+engine for the scheduler drain/resume chain, one tiny paxos engine for
+the daemon cycle chain — each WaveScheduler is reused across every
+serve round of its test).  Everything else is device-free and
+smoke-marked.  The cross-process halves (SIGTERM, SIGKILL+restart,
+warm zero-compile) live in tools/daemon_smoke.py, which ci_smoke.sh
+runs over the real CLI.
+"""
+
+import importlib.util
+import inspect
+import json
+import os
+import time
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, ModelConfig, NEXT_ASYNC
+from raft_tla_tpu.obs import Heartbeat, Obs, RunLedger, RunRegistry
+from raft_tla_tpu.resil import chaos
+from raft_tla_tpu.resil.chaos import InjectedFault
+from raft_tla_tpu.resil.supervisor import RETRYABLE
+from raft_tla_tpu.serve import (Daemon, ExecCache, Job, ResultCache,
+                                SpoolIntake, StreamTail, WaveScheduler,
+                                run_jobs)
+from raft_tla_tpu.serve.batch import BucketEngine, _default_serve_bucket
+from raft_tla_tpu.spec.paxos.config import PaxosConfig
+
+from conftest import cached_explore
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MICRO = ModelConfig(
+    n_servers=2, init_servers=(0, 1), values=(1,),
+    next_family=NEXT_ASYNC, symmetry=True, max_inflight_override=4,
+    bounds=Bounds.make(max_log_length=1, max_timeouts=1,
+                       max_client_requests=1))
+PAX = PaxosConfig(n_servers=2, n_ballots=2, n_values=1)
+# the same model as a client-side job record (serve/jobs README shape)
+PAX_JOB = {"spec": "paxos",
+           "config": {"acceptors": 2, "ballots": 2, "values": 1},
+           "max_depth": 3, "label": "pax"}
+
+
+def _write_raw(intake, name, data):
+    """A NON-conforming client: bytes straight into incoming/ (the
+    submit() helper always writes valid JSON + newline)."""
+    path = os.path.join(intake.dirs["incoming"], name)
+    with open(path, "wb") as fh:
+        fh.write(data)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# spool protocol (intake edge cases — device-free)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+def test_spool_claim_quarantine_and_guards(tmp_path):
+    """One poll() sweep: complete submissions claim (always as
+    NAME.json), malformed ones quarantine with a .reason file, torn
+    writes get the grace window, and tmp/part/dot names are never
+    touched."""
+    intake = SpoolIntake(str(tmp_path), grace_s=0.2)
+    intake.submit(PAX_JOB, "good")
+    # a conforming client under a bare name (no .json): claimed file
+    # still normalizes to NAME.json
+    _write_raw(intake, "bare", (json.dumps(PAX_JOB) + "\n").encode())
+    _write_raw(intake, "garbage.json", b"{not json\n")
+    _write_raw(intake, "badkey.json",
+               (json.dumps({"spec": "paxos", "bogus": 1}) +
+                "\n").encode())
+    _write_raw(intake, "torn.json", b'{"spec": "paxos"')   # no newline
+    _write_raw(intake, "skip.json.tmp", b"x")
+    _write_raw(intake, "skip.part", b"x")
+    _write_raw(intake, ".hidden.json", b"x")
+
+    claimed, rejected = intake.poll()
+    assert sorted(s.name for s in claimed) == ["bare", "good"]
+    for sub in claimed:
+        assert sub.path == os.path.join(intake.dirs["claimed"],
+                                        sub.name + ".json")
+        assert os.path.exists(sub.path)
+        assert not sub.recovered
+        assert sub.job.ir.name == "paxos"
+    rej = dict(rejected)
+    assert set(rej) == {"garbage", "badkey"}
+    assert "bogus" in rej["badkey"]
+    for name in rej:
+        assert os.path.exists(os.path.join(
+            intake.dirs["rejected"], name + ".json"))
+        with open(os.path.join(intake.dirs["rejected"],
+                               name + ".json.reason")) as fh:
+            assert fh.read().strip() == rej[name].strip()
+    # the torn file rode its grace window: untouched this poll
+    assert os.path.exists(os.path.join(intake.dirs["incoming"],
+                                       "torn.json"))
+    # the guarded names are invisible to claiming AND to counts()
+    counts = intake.counts()
+    assert counts == {"incoming": 1, "claimed": 2, "rejected": 2,
+                      "results": 0, "done": 0}
+
+    # past the grace the torn write quarantines with a named reason
+    time.sleep(0.25)
+    claimed2, rejected2 = intake.poll()
+    assert claimed2 == []
+    assert len(rejected2) == 1 and rejected2[0][0] == "torn"
+    assert "no trailing newline" in rejected2[0][1]
+    assert os.path.exists(os.path.join(intake.dirs["rejected"],
+                                       "torn.json"))
+
+    # result + done marker retire the claim
+    intake.write_result("good", {"status": "done", "label": "pax",
+                                 "cache_key": "k", "violations": 0})
+    intake.mark_done("good", {"status": "done", "label": "pax",
+                              "cache_key": "k"})
+    with open(os.path.join(intake.dirs["done"], "good.json")) as fh:
+        marker = json.load(fh)
+    assert marker == {"name": "good", "status": "done",
+                      "label": "pax", "cache_key": "k"}
+    assert not os.path.exists(os.path.join(intake.dirs["claimed"],
+                                           "good.json"))
+
+    # submit() refuses names that would escape or hide in the spool
+    with pytest.raises(ValueError):
+        intake.submit(PAX_JOB, "a" + os.sep + "b")
+    with pytest.raises(ValueError):
+        intake.submit(PAX_JOB, ".dot")
+
+
+@pytest.mark.smoke
+def test_spool_recover_reclaims_finalizes_and_quarantines(tmp_path):
+    """The restart contract: a leftover claimed file re-enters the
+    queue (recovered=True); one whose result already landed is
+    finalized, not recomputed; a tampered one quarantines."""
+    intake = SpoolIntake(str(tmp_path), grace_s=0.0)
+    intake.submit(PAX_JOB, "inflight")
+    intake.submit(dict(PAX_JOB, label="fin"), "finished")
+    claimed, _ = intake.poll()
+    assert len(claimed) == 2
+    # "finished" died between the result write and the done marker
+    intake.write_result("finished", {"status": "done", "label": "fin",
+                                     "cache_key": "k2"})
+    with open(os.path.join(intake.dirs["claimed"],
+                           "tampered.json"), "w") as fh:
+        fh.write("{broken\n")
+
+    recovered, rejected = intake.recover()
+    assert [s.name for s in recovered] == ["inflight"]
+    assert recovered[0].recovered
+    # finalized from its surviving result: done marker written, claim
+    # retired, NOT handed back for recompute
+    with open(os.path.join(intake.dirs["done"],
+                           "finished.json")) as fh:
+        assert json.load(fh)["cache_key"] == "k2"
+    assert not os.path.exists(os.path.join(intake.dirs["claimed"],
+                                           "finished.json"))
+    assert [name for name, _ in rejected] == ["tampered"]
+    assert os.path.exists(os.path.join(intake.dirs["rejected"],
+                                       "tampered.json.reason"))
+    # idempotent: a second recover re-claims the same leftover again
+    recovered2, _ = intake.recover()
+    assert [s.name for s in recovered2] == ["inflight"]
+
+
+@pytest.mark.smoke
+def test_stream_tail_offsets_and_partial_lines(tmp_path):
+    """The JSONL stream tail: complete lines materialize as ordered
+    stream-<n> submissions, a partial final line waits for its
+    newline, and the persisted offset makes restarts resume without
+    re-submitting or dropping."""
+    intake = SpoolIntake(str(tmp_path / "spool"))
+    stream_path = str(tmp_path / "jobs.jsonl")
+    with open(stream_path, "w") as fh:
+        fh.write(json.dumps(PAX_JOB) + "\n")
+        fh.write("# a comment line\n\n")
+        fh.write(json.dumps(dict(PAX_JOB, label="p2")) + "\n")
+        fh.write('{"spec": "paxos"')          # writer mid-append
+    tail = StreamTail(stream_path, intake)
+    assert tail.poll() == 2
+    inc = sorted(os.listdir(intake.dirs["incoming"]))
+    assert inc == ["stream-000001.json", "stream-000002.json"]
+    # nothing new, partial line still unconsumed
+    assert tail.poll() == 0
+    # the writer finishes its line and appends one more
+    with open(stream_path, "a") as fh:
+        fh.write(', "label": "p3"}\n')
+        fh.write(json.dumps(dict(PAX_JOB, label="p4")) + "\n")
+    assert tail.poll() == 2
+    assert sorted(os.listdir(intake.dirs["incoming"]))[-1] == \
+        "stream-000004.json"
+    # restart: a fresh tail resumes from the persisted offset
+    tail2 = StreamTail(stream_path, intake)
+    assert tail2.offset == tail.offset and tail2.lineno == 4
+    assert tail2.poll() == 0
+    # the materialized submissions parse through the normal protocol
+    claimed, rejected = intake.poll()
+    assert len(claimed) == 4 and rejected == []
+    assert claimed[2].job.label == "p3"
+
+
+@pytest.mark.smoke
+def test_chaos_intake_site_is_retryable_and_idempotent(tmp_path):
+    """An injected intake fault aborts the scan BEFORE the claim
+    rename: the submission survives in incoming/ and the next poll
+    claims it — and the fault type is in the daemon's RETRYABLE set,
+    so `--retries` covers the intake path too."""
+    intake = SpoolIntake(str(tmp_path))
+    intake.submit(PAX_JOB, "j1")
+    chaos.install("intake:at=1")
+    try:
+        with pytest.raises(InjectedFault) as exc:
+            intake.poll()
+        assert exc.value.site == "intake"
+        assert isinstance(exc.value, RETRYABLE)
+        assert os.listdir(intake.dirs["claimed"]) == []
+        assert os.path.exists(os.path.join(intake.dirs["incoming"],
+                                           "j1.json"))
+    finally:
+        chaos.uninstall()
+    claimed, _ = intake.poll()
+    assert [s.name for s in claimed] == ["j1"]
+
+
+# ---------------------------------------------------------------------------
+# routing: ONE copy of the driver loop (serve/scheduler)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+def test_run_jobs_and_daemon_route_through_scheduler(monkeypatch):
+    """`cli batch` (run_jobs) and the daemon cycle are thin calls into
+    WaveScheduler.serve — pinned the way test_driver pins the engine
+    drivers, so a second scheduling-rule copy can't grow back."""
+    calls = {}
+
+    def fake_serve(self, jobs, obs=None, sequential=False,
+                   verbose=False, stop=None):
+        calls["jobs"] = list(jobs)
+        calls["sequential"] = sequential
+        return "SENTINEL"
+
+    monkeypatch.setattr(WaveScheduler, "serve", fake_serve)
+    out = run_jobs([Job(PAX, max_depth=1)], sequential=True)
+    assert out == "SENTINEL"
+    assert calls["sequential"] is True and len(calls["jobs"]) == 1
+    # source pins: the wrapper and the cycle hold no driver loop of
+    # their own — they construct/call the shared core and nothing else
+    src = inspect.getsource(run_jobs)
+    assert "WaveScheduler(" in src and ".serve(" in src
+    assert "run_wave" not in src
+    cyc = inspect.getsource(Daemon.run_cycle)
+    assert "self.sched.serve(" in cyc
+    assert "run_wave" not in cyc and "BucketEngine" not in cyc
+
+
+@pytest.mark.smoke
+def test_bucket_program_donation_mode(tmp_path):
+    """With a persistent executable cache the bucket program compiles
+    WITHOUT carry donation (a donated executable deserialized in
+    another process returns corrupted carries — the daemon_smoke
+    warm-restart path caught it), and the mode is part of the
+    executable's cache identity."""
+    ceiling, params = _default_serve_bucket(PAX)
+    be = BucketEngine(ceiling, exec_cache=ExecCache(str(tmp_path)),
+                      **params)
+    assert be._donate is False
+    assert be._exec_key_parts(1)["donate"] is False
+    assert be._fn is be.eng.burst_batched_fn(donate=False)
+    # both variants exist side by side and memoize independently
+    assert be.eng.burst_batched_fn(donate=True) is not be._fn
+    assert be.eng.burst_batched_fn(donate=False) is be._fn
+    be2 = BucketEngine(ceiling, **params)
+    assert be2._donate is True
+    assert be2._exec_key_parts(1)["donate"] is True
+    assert be2._fn is be2.eng.burst_batched_fn()
+
+
+# ---------------------------------------------------------------------------
+# scheduler drain/defer/resume (the ONE raft bucket compile)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_drain_defers_and_resumes_bit_exact(tmp_path):
+    """The graceful-drain contract in one process: a stop that fires
+    before any work defers everything with ZERO compiles; one that
+    fires mid-BFS (after the first wave-state persist) parks the job
+    and defers it; the next serve() resumes it from the carry
+    bit-exact against the oracle; the one after answers from the
+    result cache — all on one persistent scheduler (one engine
+    compile total)."""
+    waves = tmp_path / "waves"
+    sched = WaveScheduler(cache=ResultCache(str(tmp_path / "cache")),
+                          wave_state=str(waves),
+                          # one BFS level per device call, so the
+                          # depth-6 job spans several step boundaries
+                          bucket_overrides={"burst_levels": 1})
+
+    def job():
+        return Job(MICRO, max_depth=6, label="m6")
+
+    # drain-before-work: deferred at the bucket gate, nothing compiled
+    rep0 = sched.serve([job()], stop=lambda: True)
+    assert rep0.outcomes == [None]
+    assert rep0.meta["drained"] and rep0.meta["deferred_jobs"] == 1
+    assert rep0.meta["engines_compiled"] == 0
+    assert rep0.meta["batch_dispatches"] == 0
+
+    # drain mid-BFS: the stop trips at the first step boundary AFTER
+    # the carry persisted (exactly the daemon's SIGTERM timing)
+    def stop_after_persist():
+        return waves.is_dir() and any(
+            fn.endswith(".wave.npz") for fn in os.listdir(waves))
+
+    assert not stop_after_persist()
+    rep1 = sched.serve([job()], stop=stop_after_persist)
+    assert rep1.outcomes == [None]
+    assert rep1.meta["drained"] and rep1.meta["deferred_jobs"] == 1
+    assert rep1.meta["engines_compiled"] == 1
+    assert rep1.meta["batch_dispatches"] >= 1
+    assert stop_after_persist(), "the deferred carry must survive"
+
+    # resume: mid-BFS from the carry, same engine (no recompile),
+    # bit-exact vs the oracle
+    rep2 = sched.serve([job()])
+    o = rep2.outcomes[0]
+    assert o is not None and o.status == "done"
+    assert rep2.meta["resumed_jobs"] == 1
+    assert rep2.meta["engines_compiled"] == 0
+    assert o.report["status_reason"] == "resumed from wave state"
+    want = cached_explore(MICRO, max_depth=6)
+    assert o.report["distinct_states"] == want.distinct_states
+    assert o.report["generated_states"] == want.generated_states
+    assert o.report["depth"] == want.depth
+    assert o.report["level_sizes"] == list(want.level_sizes)
+    # answered: the carry retired so no future serve resumes stale
+    # state
+    assert not stop_after_persist()
+
+    # and the result cache now owns the answer outright
+    rep3 = sched.serve([job()])
+    assert rep3.meta["cache_hits"] == 1
+    assert rep3.meta["batch_dispatches"] == 0
+    assert rep3.outcomes[0].status == "cache_hit"
+
+
+# ---------------------------------------------------------------------------
+# the daemon cycle loop (the ONE paxos bucket compile)
+# ---------------------------------------------------------------------------
+
+def test_daemon_cycles_dedup_eviction_and_idle_drain(tmp_path):
+    """One in-process daemon across cycles: serve, cross-cycle cache
+    hit, in-batch duplicate, recompute after eviction, malformed
+    quarantine, then the idle drain — with the ledger/heartbeat/
+    registry surface a real `cli serve` run writes."""
+    spool = str(tmp_path / "spool")
+    cache = ResultCache(str(tmp_path / "cache"))
+    led_path = str(tmp_path / "ledger.jsonl")
+    hb_path = str(tmp_path / "hb.json")
+    reg = RunRegistry(str(tmp_path / "reg"))
+    obs = Obs(ledger=RunLedger(led_path), heartbeat=Heartbeat(hb_path),
+              registry=reg, run_info={"cmd": "serve"})
+    d = Daemon(spool, cache=cache, obs=obs, poll_s=0.0,
+               max_idle_polls=2, sleep=lambda s: None)
+
+    assert d.run_cycle() is None          # empty intake = idle cycle
+    assert d.stats["cycles"] == 0
+
+    # cycle 1: a real serve
+    d.intake.submit(PAX_JOB, "pax")
+    rep = d.run_cycle()
+    assert rep is not None and d.stats["jobs_done"] == 1
+    with open(os.path.join(spool, "results", "pax.json")) as fh:
+        res1 = json.load(fh)
+    want = cached_explore(PAX, max_depth=3)
+    assert res1["distinct_states"] == want.distinct_states
+    assert res1["depth"] == want.depth
+    assert res1["level_sizes"] == list(want.level_sizes)
+    assert os.path.exists(os.path.join(spool, "done", "pax.json"))
+
+    # cycle 2: identical job under a new name = a cache hit, zero
+    # device work, zero compiles (persistent engine aside — nothing
+    # even dispatches)
+    d.intake.submit(PAX_JOB, "pax-again")
+    rep = d.run_cycle()
+    assert rep.meta["cache_hits"] == 1
+    assert rep.meta["batch_dispatches"] == 0
+    assert d.stats["cache_hits"] == 1 and d.stats["jobs_done"] == 2
+
+    # cycle 3: two identical NEW jobs in one cycle — computed once,
+    # the duplicate answered in-batch; the shared bucket engine
+    # persists across cycles so nothing recompiles
+    twin = dict(PAX_JOB, max_depth=2)
+    d.intake.submit(twin, "twin-a")
+    d.intake.submit(twin, "twin-b")
+    rep = d.run_cycle()
+    assert rep.meta["deduped"] == 1
+    assert rep.meta["engines_compiled"] == 0
+    assert d.stats["jobs_done"] == 4
+    ra = json.load(open(os.path.join(spool, "results", "twin-a.json")))
+    rb = json.load(open(os.path.join(spool, "results", "twin-b.json")))
+    assert ra["distinct_states"] == rb["distinct_states"]
+    assert "duplicate of job" in rb.get("status_reason", "") or \
+        "duplicate of job" in ra.get("status_reason", "")
+
+    # cycle 4: eviction, then re-submission — honestly recomputed
+    cache._mem.clear()
+    for fn in os.listdir(cache.path):
+        os.unlink(os.path.join(cache.path, fn))
+    d.intake.submit(PAX_JOB, "pax-evicted")
+    rep = d.run_cycle()
+    assert rep.meta["cache_hits"] == 0
+    assert rep.meta["batch_dispatches"] >= 1
+    assert rep.meta["engines_compiled"] == 0
+    res2 = json.load(open(os.path.join(spool, "results",
+                                       "pax-evicted.json")))
+    assert res2["distinct_states"] == res1["distinct_states"]
+
+    # a malformed drop quarantines without failing the cycle
+    with open(os.path.join(spool, "incoming", "bad.json"), "w") as fh:
+        fh.write("{nope\n")
+    assert d.run_cycle() is None          # nothing claimable
+    assert d.stats["jobs_rejected"] == 1
+
+    # idle drain: run() re-recovers (nothing left), idles out, and
+    # finishes done with the full telemetry surface
+    rc = d.run()
+    assert rc == 0 and d._drain == "idle for 2 polls"
+    hb = json.load(open(hb_path))
+    assert hb["status"] == "done"
+    blk = hb["daemon"]
+    assert blk["jobs_done"] == 5 and blk["cache_hits"] == 2
+    assert blk["jobs_rejected"] == 1
+    assert blk["tenants"]["paxos"]["jobs_done"] == 5
+    assert blk["drain_reason"] == "idle for 2 polls"
+    kinds = set()
+    actions = set()
+    cycles = []
+    with open(led_path) as fh:
+        for line in fh:
+            r = json.loads(line)
+            kinds.add(r.get("kind"))
+            if r.get("kind") == "intake":
+                actions.add(r.get("action"))
+            if r.get("kind") == "daemon":
+                cycles.append(r["cycle"])
+    assert {"intake", "daemon", "tenant", "job"} <= kinds
+    assert actions == {"claimed", "rejected"}
+    assert cycles == [1, 2, 3, 4]
+    rid_recs = [rec for _rid, rec in reg.records()]
+    assert len(rid_recs) == 1
+    rec = rid_recs[0]
+    assert rec["cmd"] == "serve" and rec["status"] == "done"
+    assert rec["counters"]["jobs_done"] == 5
+    assert rec["daemon"]["status"] == "done"
+
+
+@pytest.mark.smoke
+def test_drain_with_parked_work_records_draining(tmp_path, capsys):
+    """A graceful exit that still has work parked: the heartbeat says
+    "done" (the process exited as asked) but the REGISTRY record says
+    "draining" — and `cli obs ls --cmd serve --status draining` lists
+    exactly the drain cycles a successor must pick up, with the
+    claimed file intact."""
+    spool = str(tmp_path / "spool")
+    # a leftover claim from a previous daemon's crash
+    pre = SpoolIntake(spool)
+    pre.submit(PAX_JOB, "stuck")
+    assert len(pre.poll()[0]) == 1
+    reg_dir = str(tmp_path / "reg")
+    hb_path = str(tmp_path / "hb.json")
+    obs = Obs(ledger=RunLedger(str(tmp_path / "ledger.jsonl")),
+              heartbeat=Heartbeat(hb_path),
+              registry=RunRegistry(reg_dir),
+              run_info={"cmd": "serve"})
+    d = Daemon(spool, obs=obs, poll_s=0.0, sleep=lambda s: None)
+    d.request_drain("supervisor handoff")
+    assert d.run() == 0
+    # recovered but never served: the claim survives for the successor
+    assert d.stats["jobs_recovered"] == 1
+    assert os.path.exists(os.path.join(spool, "claimed", "stuck.json"))
+    assert json.load(open(hb_path))["status"] == "done"
+    recs = [rec for _rid, rec in RunRegistry(reg_dir).records()]
+    assert len(recs) == 1 and recs[0]["status"] == "draining"
+    assert recs[0]["drain_reason"] == "supervisor handoff"
+
+    from raft_tla_tpu import cli
+    rc = cli.main(["obs", "ls", "--registry", reg_dir,
+                   "--cmd", "serve", "--status", "draining"])
+    assert not rc
+    out = capsys.readouterr().out.splitlines()
+    rows = out[1:]                        # drop the header
+    assert len(rows) == 1
+    assert "serve" in rows[0] and "draining" in rows[0]
+    # the filter is honest: nothing matches status=done
+    rc = cli.main(["obs", "ls", "--registry", reg_dir,
+                   "--cmd", "serve", "--status", "done"])
+    assert not rc
+    assert capsys.readouterr().out.splitlines()[1:] == []
+
+
+# ---------------------------------------------------------------------------
+# watch daemon view
+# ---------------------------------------------------------------------------
+
+def _load_watch():
+    spec = importlib.util.spec_from_file_location(
+        "watch", os.path.join(_REPO, "tools", "watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.smoke
+def test_watch_daemon_view_and_idle_cadence(tmp_path):
+    """An idle-but-beating daemon is healthy even when its historical
+    serving cadence says the gap is abnormal (the cadence rule is for
+    runs, not pollers); the same numbers WITHOUT a daemon block do
+    flag; a drained daemon's "done" renders FINISHED; and the daemon
+    block renders queue depths, tenant rollups and the drain
+    reason."""
+    watch = _load_watch()
+    now = time.time()
+    hb = {"pid": os.getpid(), "depth": 3, "states_enqueued": 44,
+          "status": "idle", "beats": 61,
+          "started_ts": now - 180, "last_dispatch_ts": now - 120,
+          "daemon": {"status": "idle", "cycles": 4, "incoming": 0,
+                     "claimed": 0, "done": 5, "rejected": 1,
+                     "jobs_done": 5, "cache_hits": 2, "violations": 0,
+                     "jobs_recovered": 1,
+                     "tenants": {"paxos": {"jobs_done": 5,
+                                           "cache_hits": 2,
+                                           "violations": 0}}}}
+    hb_path = str(tmp_path / "hb.json")
+    with open(hb_path, "w") as fh:
+        json.dump(hb, fh)
+    # cadence here is ~1s/beat over 61 beats; age 120s would trip the
+    # 8x-cadence rule on a batch run — the daemon block suppresses it
+    line, code = watch.status_line(hb_path, None, stale_s=300)
+    assert code == 0 and "STALLED" not in line
+    assert "daemon idle" in line and "cycle 4" in line
+    assert "served 5 jobs" in line and "2 cache hits" in line
+    assert "1 recovered" in line
+    assert "tenant paxos: 5 done" in line
+    # identical rhythm without the daemon block: the cadence rule bites
+    hb2 = {k: v for k, v in hb.items() if k != "daemon"}
+    hb2["status"] = "running"
+    with open(hb_path, "w") as fh:
+        json.dump(hb2, fh)
+    line, code = watch.status_line(hb_path, None, stale_s=300)
+    assert code == 1 and "STALLED?" in line
+    # graceful drain: terminal "done" renders FINISHED, exit 0 — and
+    # the drain reason line rides along
+    hb["status"] = "done"
+    hb["daemon"]["status"] = "done"
+    hb["daemon"]["drain_reason"] = "signal SIGTERM"
+    with open(hb_path, "w") as fh:
+        json.dump(hb, fh)
+    line, code = watch.status_line(hb_path, None, stale_s=300)
+    assert code == 0 and "FINISHED" in line
+    assert "draining: signal SIGTERM" in line
+    # the absolute stale bound still guards a wedged daemon
+    hb["status"] = "idle"
+    hb["last_dispatch_ts"] = now - 9000
+    with open(hb_path, "w") as fh:
+        json.dump(hb, fh)
+    line, code = watch.status_line(hb_path, None, stale_s=300)
+    assert code == 1 and "STALLED?" in line

@@ -47,3 +47,10 @@ env JAX_PLATFORMS=cpu python tools/sym_smoke.py
 # rc 0) and CATCH an injected depth-gate count mismatch (rc 1), with
 # resource telemetry (RSS peak, compile seconds) on the records
 env JAX_PLATFORMS=cpu python tools/obs_report_smoke.py
+# daemon gate (ISSUE 18): a real `cli serve` daemon over a spool dir —
+# two tenants served bit-exact vs a clean `cli batch` reference,
+# SIGTERM graceful drain (exit 0, registry cmd=serve), then a
+# kill-mid-wave / restart pair: the new daemon re-claims the leftover
+# job, resumes MID-BFS from its persisted wave state bit-exact, and
+# (exec cache warm) compiles zero bucket programs on the way
+env JAX_PLATFORMS=cpu python tools/daemon_smoke.py

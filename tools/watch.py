@@ -36,6 +36,24 @@ cache counters render as dashboard lines after the job map:
   service: <=1s:3 <=5s:2
   exec-cache: 2 hits, 1 misses, 1 stored
 
+Daemon mode (``cli serve`` — ISSUE 18): a daemon heartbeat carries a
+``daemon`` block (cycle counter, spool queue depths, cumulative
+done/rejected, per-tenant rollups); the daemon view renders after the
+job/SLO lines:
+
+  daemon serving  cycle 3  incoming 2 claimed 4 done 11 rejected 1
+  served 11 jobs (3 cache hits, 0 violations), 1 recovered
+  tenant raft: 7 done, 2 cache hits
+  tenant paxos: 4 done, 1 cache hit
+
+Two daemon-specific rules: a terminal ``status="done"`` heartbeat (a
+graceful drain) renders FINISHED exactly like a batch run's
+``finished`` — never a stall — and CADENCE-based stall detection is
+skipped while the daemon block says idle|serving|draining, because an
+idle daemon legitimately beats at its ``--poll`` rhythm however fast
+its serving cadence once was (the absolute ``--stale`` bound still
+applies; a dead pid still flags DEAD).
+
 Usage:
   python tools/watch.py HEARTBEAT [--ledger FILE] [--interval SEC]
                         [--stale SEC] [--cadence-factor N] [--once]
@@ -153,6 +171,35 @@ def slo_lines(hb):
     return out
 
 
+def daemon_lines(hb):
+    """The daemon view (``cli serve`` heartbeats): queue depths,
+    cumulative serve counters, per-tenant rollups and the drain
+    reason; [] for non-daemon heartbeats."""
+    d = hb.get("daemon")
+    if not d:
+        return []
+    out = [f"  daemon {d.get('status', '?')}  "
+           f"cycle {int(d.get('cycles', 0))}  "
+           f"incoming {int(d.get('incoming', 0))} "
+           f"claimed {int(d.get('claimed', 0))} "
+           f"done {int(d.get('done', 0))} "
+           f"rejected {int(d.get('rejected', 0))}"]
+    served = (f"  served {int(d.get('jobs_done', 0))} jobs "
+              f"({int(d.get('cache_hits', 0))} cache hits, "
+              f"{int(d.get('violations', 0))} violations)")
+    if d.get("jobs_recovered"):
+        served += f", {int(d['jobs_recovered'])} recovered"
+    out.append(served)
+    for name, t in (d.get("tenants") or {}).items():
+        out.append(f"  tenant {name}: {int(t.get('jobs_done', 0))} "
+                   f"done, {int(t.get('cache_hits', 0))} cache hits"
+                   + (f", {int(t['violations'])} violations"
+                      if t.get("violations") else ""))
+    if d.get("drain_reason"):
+        out.append(f"  draining: {d['drain_reason']}")
+    return out
+
+
 # a run must beat this many times before its own cadence is trusted
 # for stall detection (too few samples and one slow early level —
 # compile included — would poison the estimate)
@@ -185,8 +232,19 @@ def status_line(hb_path, ledger_path, stale_s, cadence_factor=8.0):
         return f"no heartbeat yet ({e})", 2
     age = time.time() - hb["last_dispatch_ts"]
     alive = pid_alive(int(hb["pid"]))
-    finished = hb.get("status") == "finished"
+    # "finished" is a run's terminal beat; "done" is a daemon's
+    # graceful drain — both terminal, both render FINISHED so the
+    # watch loop exits 0 instead of flagging a stall on a process
+    # that exited exactly as asked
+    finished = hb.get("status") in ("finished", "done")
     backoff = hb.get("status") == "backoff"
+    # a live daemon (idle|serving|draining) beats at its --poll
+    # rhythm while idle: its historical serving cadence says nothing
+    # about the gaps between idle beats, so cadence-based stall
+    # detection is meaningless — the absolute --stale bound and the
+    # pid check still guard a daemon that truly wedged
+    daemonish = hb.get("daemon") is not None and \
+        hb.get("status") in ("idle", "serving", "draining")
     parts = [f"depth {hb['depth']}",
              f"{hb['states_enqueued']:,} states"]
     rate = None
@@ -207,7 +265,7 @@ def status_line(hb_path, ledger_path, stale_s, cadence_factor=8.0):
     parts.append(f"last dispatch {age:.0f}s ago")
     cadence = observed_cadence(hb)
     cadence_limit = None
-    if cadence is not None and cadence_factor:
+    if cadence is not None and cadence_factor and not daemonish:
         cadence_limit = max(cadence * cadence_factor, CADENCE_FLOOR_S)
     code = 0
     if finished:
@@ -242,7 +300,7 @@ def status_line(hb_path, ledger_path, stale_s, cadence_factor=8.0):
     else:
         parts.append(f"pid {hb['pid']} alive")
     line = "  ".join(parts)
-    jl = job_lines(hb) + slo_lines(hb)
+    jl = job_lines(hb) + slo_lines(hb) + daemon_lines(hb)
     if jl:
         line = "\n".join([line] + jl)
     return line, code
