@@ -947,6 +947,155 @@ def _canon_ab(out_path):
     return out
 
 
+def _wave_mesh_ab(out_path):
+    """Mesh-sharded serving wave A/B (BENCH_r15, round 16): the SAME
+    6-job raft wave through ``cli batch`` on ONE device vs a 4-virtual-
+    device job mesh (``--wave-mesh 4``), under the shared correctness
+    gate (per-job counts/level sizes bit-identical across modes, or
+    the file is FAILED).
+
+    Subprocess runs, not in-process: the job mesh needs >1 local
+    device and this process's jax initialized with the default 1 —
+    both runs force ``--xla_force_host_platform_device_count=4`` so
+    the device count itself is identical and only ``--wave-mesh``
+    differs.  Both record into one ``--registry``, so the A/B is an
+    ``obs diff`` verdict (clean = identical counts) and the rows carry
+    the records' ``batched_dispatch`` span totals plus per-job wall
+    seconds from ``--stats-json``.
+
+    Honest CPU-fallback label: 4 virtual CPU devices share the SAME
+    physical cores, so the mesh row's seconds measure sharding
+    overhead, not speedup — the throughput claim (D devices x 8 lanes
+    per dispatch) is a TPU-slice measurement; what this file pins on
+    every container is bit-exactness, occupancy accounting and the
+    dispatch-count invariance."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    import jax
+
+    from raft_tla_tpu.obs.registry import RunRegistry
+    from raft_tla_tpu.obs.report import diff_runs
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="wave_mesh_ab_")
+    jobs_path = os.path.join(tmp, "jobs.jsonl")
+    with open(jobs_path, "w") as fh:
+        for d in (3, 4, 5, 6, 7, 8):
+            fh.write(json.dumps({
+                "spec": "raft",
+                "config": "configs/tlc_membership/raft.cfg",
+                "overrides": {
+                    "servers": 2, "values": [1], "max_inflight": 4,
+                    "next": "NextAsync",
+                    "bounds": {"max_log_length": 1, "max_timeouts": 1,
+                               "max_client_requests": 1}},
+                "max_depth": d, "label": f"r{d}"}) + "\n")
+    registry = os.path.join(tmp, "registry")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=4"
+                          ).strip())
+    rows, keys, run_ids = {}, {}, {}
+    try:
+        for label, mesh in (("single_device", "off"),
+                            ("mesh_4dev", "4")):
+            stats = os.path.join(tmp, label + ".json")
+            t0 = time.perf_counter()
+            p = subprocess.run(
+                [sys.executable, "-m", "raft_tla_tpu", "batch",
+                 "--jobs", jobs_path, "--wave-mesh", mesh,
+                 "--stats-json", stats, "--registry", registry],
+                capture_output=True, text=True, cwd=repo, env=env,
+                timeout=900)
+            wall = time.perf_counter() - t0
+            if p.returncode != 0:
+                out = {"bench": "mesh-sharded serving wave A/B "
+                                "(bench.py, BENCH_r15 round)",
+                       "status": f"FAILED: cli batch --wave-mesh "
+                                 f"{mesh} exited {p.returncode}: "
+                                 f"{p.stderr[-500:]}"}
+                tmpf = out_path + ".tmp"
+                with open(tmpf, "w") as fh:
+                    json.dump(out, fh, indent=1)
+                os.replace(tmpf, out_path)
+                return out
+            with open(stats) as fh:
+                payload = json.load(fh)
+            summary, jrows = payload["summary"], payload["jobs"]
+            keys[label] = tuple(
+                (r["label"], r["distinct_states"],
+                 r["generated_states"], r["depth"],
+                 tuple(r["level_sizes"])) for r in jrows)
+            reg = RunRegistry(registry)
+            fresh = [i for i in reg.run_ids()
+                     if i not in run_ids.values()]
+            run_ids[label] = fresh[-1]
+            rec = reg.load(run_ids[label])
+            spans = rec.get("spans") or {}
+            disp = spans.get("batched_dispatch") or {}
+            rows[label] = {
+                "run_id": run_ids[label],
+                "wall_seconds": round(wall, 2),
+                "wave_devices": int(summary.get("wave_devices", 0)),
+                "wave_lanes": int(summary.get("wave_lanes", 0)),
+                "batch_dispatches":
+                    int(summary.get("batch_dispatches", 0)),
+                "batched_dispatch_span": {
+                    "count": int(disp.get("count", 0)),
+                    "seconds": round(float(disp.get("seconds", 0.0)),
+                                     4)},
+                "bucket_compile_seconds": round(float(
+                    (spans.get("bucket_compile") or {})
+                    .get("seconds", 0.0)), 4),
+                "per_job_seconds": {
+                    r["label"]: round(float(r.get("seconds", 0.0)), 4)
+                    for r in jrows},
+            }
+        reg = RunRegistry(registry)
+        diff = diff_runs(reg.load(run_ids["single_device"]),
+                         reg.load(run_ids["mesh_4dev"]))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    identical = len(set(keys.values())) == 1
+    occupancy_ok = (rows["mesh_4dev"]["wave_devices"] == 4 and
+                    rows["single_device"]["wave_devices"] == 1 and
+                    rows["mesh_4dev"]["batch_dispatches"] ==
+                    rows["single_device"]["batch_dispatches"])
+    diff_ok = diff["verdict"] in ("clean", "mode_drift")
+    ok = identical and occupancy_ok and diff_ok
+    out = {
+        "bench": "mesh-sharded serving wave A/B: one 6-job raft wave, "
+                 "--wave-mesh off vs 4 virtual devices (bench.py, "
+                 "BENCH_r15 round)",
+        "platform": jax.default_backend(),
+        "honest_label": (
+            "CPU-only fallback: the 4 'devices' are virtual XLA:CPU "
+            "devices on the SAME physical cores, so the mesh row's "
+            "seconds measure GSPMD sharding overhead, not speedup — "
+            "the D-devices-x-8-lanes throughput multiplier is a TPU-"
+            "slice measurement; bit-exactness, wave occupancy "
+            "accounting and dispatch-count invariance are the "
+            "platform-independent content"
+            if jax.default_backend() == "cpu" else "TPU-measured"),
+        "status": ("ok" if ok else
+                   "FAILED: mesh-wave counts diverge from the single-"
+                   "device wave (or the occupancy/diff verdict is "
+                   "wrong) — the perf rows are meaningless"),
+        "counts_identical": identical,
+        "occupancy_ok": occupancy_ok,
+        "obs_diff_verdict": diff["verdict"],
+        "registry_run_ids": run_ids,
+        "rows": rows,
+    }
+    tmpf = out_path + ".tmp"
+    with open(tmpf, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmpf, out_path)
+    return out
+
+
 def _bench_registry_record(registry_dir, headline):
     """Append one ``cmd="bench"`` record to a run registry (ISSUE 17)
     so ``cli obs ls/diff/regress`` can query bench results next to
@@ -1057,6 +1206,10 @@ def _no_reference_fallback(registry=None):
     canon_ab = _canon_ab(os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "BENCH_r14.json"))
     gate_ok = gate_ok and canon_ab["status"] == "ok"
+    # round 15 file (PR 18): mesh-sharded serving waves, same gate
+    wave_mesh_ab = _wave_mesh_ab(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r15.json"))
+    gate_ok = gate_ok and wave_mesh_ab["status"] == "ok"
     out = {
         "metric": "distinct_states_per_sec_tlc_membership_S3_T3_L3",
         "value": None, "unit": "states/sec", "vs_baseline": None,
@@ -1110,7 +1263,16 @@ def _no_reference_fallback(registry=None):
                        "fingerprint_phase_speedup":
                            canon_ab["fingerprint_phase_speedup"],
                        "hard_fallback_rate":
-                           canon_ab["hard_fallback_rate"]}}}
+                           canon_ab["hard_fallback_rate"]},
+                   "wave_mesh_ab": {
+                       "written_to": "BENCH_r15.json",
+                       "status": wave_mesh_ab["status"],
+                       "obs_diff_verdict":
+                           wave_mesh_ab.get("obs_diff_verdict"),
+                       "wall_seconds": {
+                           k: v["wall_seconds"]
+                           for k, v in (wave_mesh_ab.get("rows") or
+                                        {}).items()}}}}
     print(json.dumps(out))
     _bench_registry_record(registry, out)
 
@@ -1235,6 +1397,9 @@ def main():
     canon_ab = _canon_ab(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_r14.json"))
     gate_ok = gate_ok and canon_ab["status"] == "ok"
+    wave_mesh_ab = _wave_mesh_ab(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r15.json"))
+    gate_ok = gate_ok and wave_mesh_ab["status"] == "ok"
 
     # -- perf regression floor (BENCH_FLOOR.json; VERDICT r3 #5) --------
     # Only meaningful for the full-depth run on the recorded machine
@@ -1288,6 +1453,7 @@ def main():
     out["detail"]["ceiling_ab_status"] = ceiling_ab["status"]
     out["detail"]["pjit_ab_status"] = pjit_ab["status"]
     out["detail"]["canon_ab_status"] = canon_ab["status"]
+    out["detail"]["wave_mesh_ab_status"] = wave_mesh_ab["status"]
     print(json.dumps(out))
     _bench_registry_record(registry, out)
 

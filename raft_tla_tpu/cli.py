@@ -813,6 +813,16 @@ def cmd_batch(args):
         print(f"--wave-yield must be >= 1 (got {args.wave_yield})",
               file=sys.stderr)
         return 2
+    if args.max_wave is not None and args.max_wave < 1:
+        print(f"--max-wave must be >= 1 (got {args.max_wave})",
+              file=sys.stderr)
+        return 2
+    try:
+        from .serve.batch import resolve_wave_mesh
+        resolve_wave_mesh(args.wave_mesh)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     if args.executable_cache_max_bytes is not None:
         if args.executable_cache_max_bytes <= 0:
             print(f"--executable-cache-max-bytes must be positive "
@@ -852,6 +862,8 @@ def cmd_batch(args):
                                verbose=args.verbose,
                                wave_state=args.wave_state,
                                wave_yield=args.wave_yield,
+                               max_wave=args.max_wave,
+                               wave_mesh=args.wave_mesh,
                                bucket_overrides=(
                                    {"sym_canon": args.sym_canon}
                                    if args.sym_canon != "auto"
@@ -923,6 +935,16 @@ def cmd_serve(args):
         print(f"--wave-yield must be >= 1 (got {args.wave_yield})",
               file=sys.stderr)
         return 2
+    if args.max_wave is not None and args.max_wave < 1:
+        print(f"--max-wave must be >= 1 (got {args.max_wave})",
+              file=sys.stderr)
+        return 2
+    try:
+        from .serve.batch import resolve_wave_mesh
+        resolve_wave_mesh(args.wave_mesh)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     if args.cache_max_bytes is not None and args.cache_max_bytes <= 0:
         print(f"--cache-max-bytes must be positive (got "
               f"{args.cache_max_bytes}); omit it for an unbounded "
@@ -960,6 +982,7 @@ def cmd_serve(args):
         args.spool, cache=cache, wave_state=wave_dir,
         exec_cache=exec_cache, obs=obs, poll_s=args.poll,
         wave_yield=args.wave_yield,
+        max_wave=args.max_wave, wave_mesh=args.wave_mesh,
         bucket_overrides=({"sym_canon": args.sym_canon}
                           if args.sym_canon != "auto" else None),
         retries=args.retries, backoff=args.backoff,
@@ -1449,6 +1472,17 @@ def main(argv=None):
                          "N batched device calls while other jobs "
                          "wait (higher Job priority runs first); "
                          "parked jobs continue in a later wave")
+    pb.add_argument("--max-wave", type=int, default=None, metavar="N",
+                    help="jobs-per-wave ceiling (default: 8 per mesh "
+                         "device); shrink it to force parking or to "
+                         "bound wave memory")
+    pb.add_argument("--wave-mesh", default="auto", metavar="auto|N|off",
+                    help="shard each batched wave's job axis across a "
+                         "mesh of local devices: 'auto' (default) = "
+                         "all local devices when more than one, 'off' "
+                         "= the single-device wave, N = the first N "
+                         "devices; per-job results are bit-exact in "
+                         "every mode")
     pb.add_argument("--retries", type=int, default=0, metavar="N",
                     help="re-run the job list up to N times on a "
                          "transient failure, with bounded exponential "
@@ -1536,6 +1570,15 @@ def main(argv=None):
                     help="fairness: a wave yields its lanes after N "
                          "batched device calls while other claimed "
                          "jobs wait (higher Job priority runs first)")
+    pd.add_argument("--max-wave", type=int, default=None, metavar="N",
+                    help="jobs-per-wave ceiling (default: 8 per mesh "
+                         "device; see batch --max-wave)")
+    pd.add_argument("--wave-mesh", default="auto", metavar="auto|N|off",
+                    help="job-axis mesh sharding for every wave (see "
+                         "batch --wave-mesh); the daemon restart "
+                         "matrix is portable — a mesh-mode restart "
+                         "resumes single-device wave state and vice "
+                         "versa")
     pd.add_argument("--retries", type=int, default=0, metavar="N",
                     help="re-run a failed serve cycle up to N times "
                          "with bounded exponential backoff "

@@ -1492,7 +1492,7 @@ class Engine:
             return nst, out
         return jax.vmap(one)(jst, lv_left, st_cap)
 
-    def burst_batched_fn(self, donate: bool = True):
+    def burst_batched_fn(self, donate: bool = True, sharding=None):
         """The jitted job-axis burst entry point (lazy: solo checks
         never pay for it).  The serving layer AOT-compiles it per
         (bucket, padded job count) via ``.lower(...).compile()`` so the
@@ -1509,15 +1509,31 @@ class Engine:
         donation-free variant whenever a persistent executable cache
         is in play, trading one carry's worth of device memory for a
         program that round-trips serialization exactly
-        (tools/daemon_smoke.py pins the kill->restart path warm)."""
+        (tools/daemon_smoke.py pins the kill->restart path warm).
+
+        ``sharding`` (a job-axis ``NamedSharding``, or None) is applied
+        as a pytree-prefix ``in_shardings``/``out_shardings`` over the
+        whole carry: every leaf of ``jst`` and ``out`` leads with the
+        [J] job axis, so ONE spec splits the wave across devices and
+        GSPMD partitions the body with no data collectives (each lane
+        is independent; only the vmapped while-loop condition reduces
+        across jobs).  The body needs no changes — the same program
+        serves one device or a whole mesh."""
         if self._bat_jit is None:
             _register_barrier_batching()
-            self._bat_jit = {
-                True: jax.jit(self._batched_burst_impl,
-                              donate_argnums=0),
-                False: jax.jit(self._batched_burst_impl),
-            }
-        return self._bat_jit[bool(donate)]
+            self._bat_jit = {}
+        key = (bool(donate), sharding)
+        fn = self._bat_jit.get(key)
+        if fn is None:
+            kwargs = {}
+            if donate:
+                kwargs["donate_argnums"] = 0
+            if sharding is not None:
+                kwargs["in_shardings"] = (sharding, sharding, sharding)
+                kwargs["out_shardings"] = sharding
+            fn = jax.jit(self._batched_burst_impl, **kwargs)
+            self._bat_jit[key] = fn
+        return fn
 
     def _burst_impl(self, carry, fam_caps, levels_left, states_cap):
         """Classic-carry wrapper around _burst_core: slice the ring out

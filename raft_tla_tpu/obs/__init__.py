@@ -92,6 +92,7 @@ class Obs:
         self._n_dispatch = 0
         self._last_jobs = None
         self._last_slo = None
+        self._last_wave = None
         self._last_daemon = None
         self._last_metrics: Optional[Dict] = None
         # one id per run, stamped into every ledger row (RunLedger's
@@ -135,7 +136,8 @@ class Obs:
                  metrics: Optional[Dict] = None,
                  states: Optional[int] = None,
                  jobs: Optional[Dict] = None,
-                 slo: Optional[Dict] = None):
+                 slo: Optional[Dict] = None,
+                 wave: Optional[Dict] = None):
         """One record per dispatch (burst device call / per-level round
         trip / sim dispatch / batched multi-job call): ledger line +
         heartbeat rewrite.  ``jobs`` is the serving layer's per-job
@@ -146,7 +148,12 @@ class Obs:
         ``slo`` is the serving layer's SLO snapshot (queue depth,
         wait/service-seconds histograms, exec-cache counters): it
         rides the heartbeat next to the job map — watch renders the
-        queue line — and the ledger record carries queue_depth."""
+        queue line — and the ledger record carries queue_depth.
+        ``wave`` (round 16) is the batched wave's occupancy snapshot
+        ({devices, lanes, filled, pad, jobs_per_device}): the ledger
+        record gets ``wave_devices``/``wave_lanes``/``wave_pad`` and
+        the heartbeat carries the full block for watch's ``pad N/M``
+        line."""
         self._n_dispatch += 1
         metrics = metrics or {}
         if metrics:
@@ -194,17 +201,25 @@ class Obs:
                     if j.get("status") == "running")
             if slo is not None and "queue_depth" in slo:
                 rec["queue_depth"] = int(slo["queue_depth"])
+            if wave is not None:
+                rec["wave_devices"] = int(wave.get("devices", 1))
+                rec["wave_lanes"] = int(wave.get("lanes", 0))
+                rec["wave_pad"] = int(wave.get("pad", 0))
             self.ledger.record(rec)
         if jobs is not None:
             self._last_jobs = jobs
         if slo is not None:
             self._last_slo = dict(slo)
+        if wave is not None:
+            self._last_wave = dict(wave)
         if self.heartbeat is not None:
             extra = {}
             if jobs is not None:
                 extra["jobs"] = jobs
             if slo is not None:
                 extra["slo"] = dict(slo)
+            if wave is not None:
+                extra["wave"] = dict(wave)
             if res_snap is not None:
                 extra["resources"] = res_snap
             if self._last_daemon is not None:
@@ -321,6 +336,8 @@ class Obs:
                         if self._last_jobs is not None else {}) |
                        ({"slo": self._last_slo}
                         if self._last_slo is not None else {}) |
+                       ({"wave": self._last_wave}
+                        if self._last_wave is not None else {}) |
                        ({"resources": self._resources.sample()}
                         if self._resources is not None else {}) |
                        ({"daemon": self._last_daemon}

@@ -10,8 +10,18 @@ tenants, the same move PR 5 made across levels:
   (spec, ceiling config, bucket params).  One ``BucketEngine`` per
   bucket compiles ONE job-vmapped burst program
   (``engine/bfs.Engine.burst_batched_fn``) and serves every job in the
-  bucket through it, in waves of up to ``_MAX_WAVE`` jobs padded to a
-  power of two (so the wave-size compile cache stays tiny).
+  bucket through it, in waves of up to ``_MAX_WAVE`` jobs per device
+  padded to a power of two (so the wave-size compile cache stays
+  tiny).
+- **Mesh waves** (round 16) — with more than one local device (a TPU
+  slice, or CPU via ``--xla_force_host_platform_device_count``), the
+  job axis shards across a ``jax.make_mesh`` (``--wave-mesh``): one
+  job-axis ``NamedSharding`` covers every leading-[J] leaf of the
+  carry, GSPMD splits the wave with no data collectives, waves pad to
+  a mesh multiple, and the ceiling scales to devices x 8 lanes.  The
+  per-job harvest, park/resume slices and wave-state files stay
+  host-side numpy, so the same ``.wave.npz`` restores under ANY mesh
+  shape (the portable restart matrix).
 - **Job axis** — per-job frontier rings, visited tables, global-id
   cursors, depth gates and invariant verdicts all ride a leading
   ``[J, ...]`` axis.  JAX batches the burst's while_loops as
@@ -65,6 +75,37 @@ def _default_serve_bucket(cfg):
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
+
+
+def resolve_wave_mesh(value) -> int:
+    """Normalize a ``--wave-mesh`` spec to a device count D.
+
+    ``"auto"``/None -> all local devices when more than one is
+    visible, else 0 (mesh off — the historical single-device wave).
+    ``"off"``/0/1 -> 0.  An integer N shards across the first N local
+    devices and must fit the backend; anything else is a ValueError
+    with the offending value named (the CLI turns it into exit 2,
+    never a traceback)."""
+    import jax
+    avail = jax.local_device_count()
+    if value is None or value == "auto":
+        return avail if avail > 1 else 0
+    if value == "off":
+        return 0
+    try:
+        n = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"--wave-mesh must be 'auto', 'off' or a device count, "
+            f"got {value!r}")
+    if n < 0:
+        raise ValueError(f"--wave-mesh device count must be >= 0, "
+                         f"got {n}")
+    if n > avail:
+        raise ValueError(
+            f"--wave-mesh {n} exceeds the {avail} visible local "
+            f"device(s)")
+    return n if n > 1 else 0
 
 
 # ---------------------------------------------------------------------------
@@ -391,7 +432,8 @@ class BucketEngine:
 
     def __init__(self, cfg, chunk: int = 128, vcap: int = 1 << 15,
                  burst_levels: int = 8, delta_matmul: bool = True,
-                 sym_canon: str = "auto", exec_cache=None):
+                 sym_canon: str = "auto", exec_cache=None,
+                 wave_mesh: int = 0):
         from ..engine.bfs import Engine
         # dedup_kernel="off": the Pallas probe kernel has no batching
         # rule; the lax claim walk is bit-identical in every mode
@@ -419,7 +461,25 @@ class BucketEngine:
         # programs must be the SAME program, so the choice is made
         # once here and recorded in _exec_key_parts.
         self._donate = exec_cache is None
-        self._fn = self.eng.burst_batched_fn(donate=self._donate)
+        # mesh mode (round 16): shard the job axis across D local
+        # devices.  Every leaf of the batched carry leads with [J], so
+        # ONE job-axis NamedSharding is the pytree-prefix spec for the
+        # whole program — GSPMD splits the wave with no data
+        # collectives (lanes are independent) and the per-job harvest
+        # slicing below stays host-side and mode-blind.
+        self.mesh_devices = int(wave_mesh or 0)
+        if self.mesh_devices > 1:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            mesh = jax.make_mesh(
+                (self.mesh_devices,), ("jobs",),
+                devices=jax.devices()[:self.mesh_devices])
+            self._sharding = NamedSharding(mesh, PartitionSpec("jobs"))
+        else:
+            self.mesh_devices = 0
+            self._sharding = None
+        self._fn = self.eng.burst_batched_fn(donate=self._donate,
+                                             sharding=self._sharding)
         self._compiled = {}            # padded J -> AOT executable
         # constant-padding ceilings (round 13): with a serve_runtime
         # hook, every job's guard thresholds / family lane mask /
@@ -477,6 +537,12 @@ class BucketEngine:
             # donation mode is program identity: a donated executable
             # must never be revived cross-process (see __init__)
             "donate": self._donate,
+            # mesh shape is program identity too: a 4-device sharded
+            # executable must read as a NAMED miss on a 1-device
+            # process (and vice versa), never a wrong load.  JP above
+            # already covers the wave-lane width the mesh multiple
+            # changes.
+            "wave_mesh": self.mesh_devices,
         }
 
     # -- root admission ------------------------------------------------
@@ -564,6 +630,29 @@ class BucketEngine:
             out["rt"] = self._rt_of(eng.cfg)
         return out
 
+    def _pad_jp(self, n: int) -> int:
+        """Wave width for n admitted jobs.  Single-device: the next
+        power of two (tiny compile cache).  Mesh mode: a mesh multiple
+        D * pow2(ceil(n/D)), so every device holds the same per-device
+        lane count and the pad lanes (frozen, nf=0) are the only
+        idle-lane waste — surfaced as ``pad N/M`` by tools/watch."""
+        D = self.mesh_devices
+        if D > 1:
+            return D * _next_pow2(max(1, -(-n // D)))
+        return _next_pow2(n)
+
+    def _place(self, x):
+        """Device placement for one wave-input pytree: under the job
+        mesh when sharding, else jax's default (single device).  Host
+        numpy in (the _stack/_job_slice format is host-side and
+        mode-blind) -> committed device arrays out, so a parked or
+        restored carry re-enters ANY mesh shape — the wave.npz
+        restart matrix is portable by construction."""
+        if self._sharding is None:
+            return x
+        import jax
+        return jax.device_put(x, self._sharding)
+
     def _stack(self, inits):
         import jax.numpy as jnp
         eng = self.eng
@@ -580,7 +669,7 @@ class BucketEngine:
                 nm: jnp.asarray(np.stack(
                     [np.asarray(it["rt"][nm]) for it in inits]))
                 for nm in ("thr", "mask", "bounds")})
-        return dict(
+        return self._place(dict(
             **rt,
             vis=tuple(jnp.asarray(np.stack([it["vis"][w]
                                             for it in inits]))
@@ -598,7 +687,7 @@ class BucketEngine:
                                    np.int32)),
             pg=jnp.asarray(np.array([int(it.get("pg", 0))
                                      for it in inits], np.int32)),
-        )
+        ))
 
     def _job_slice(self, jst, k: int) -> Dict:
         """One job's lane of the batched carry -> a host init dict
@@ -667,10 +756,20 @@ class BucketEngine:
                 if not run.fallback:
                     run.finish()
             return
-        JP = _next_pow2(len(admitted))
+        JP = self._pad_jp(len(admitted))
         inits = [init for _run, init in admitted]
         inits += [self._pad_init()] * (JP - len(admitted))
         jst = self._stack(inits)
+        # wave occupancy (round 16): devices x lanes and the pad
+        # waste, for the heartbeat/ledger and the registry counters
+        wave_dev = max(1, self.mesh_devices)
+        wave_occ = {"devices": wave_dev, "lanes": JP,
+                    "filled": len(admitted),
+                    "pad": JP - len(admitted),
+                    "jobs_per_device": JP // wave_dev}
+        meta["wave_devices"] = max(meta.get("wave_devices", 0),
+                                   wave_dev)
+        meta["wave_lanes"] = max(meta.get("wave_lanes", 0), JP)
         steps = 0
         while any(run.live for run, _ in admitted):
             # chaos site: dispatch-time device/tunnel error on the
@@ -686,7 +785,8 @@ class BucketEngine:
                     cap[k] = max(1, min(
                         run.job.max_states - run.res.distinct_states,
                         2 ** 31 - 1))
-            lvj, capj = jnp.asarray(lv), jnp.asarray(cap)
+            lvj = self._place(jnp.asarray(lv))
+            capj = self._place(jnp.asarray(cap))
             ex = self._compiled.get(JP)
             key = parts = None
             if ex is None and self.exec_cache is not None:
@@ -775,7 +875,7 @@ class BucketEngine:
                     "generated_states": sum(
                         int(r.res.generated_states)
                         for r in live_runs)},
-                jobs=jobs_map, slo=slo_ctx)
+                jobs=jobs_map, slo=slo_ctx, wave=wave_occ)
             if verbose:
                 done = sum(1 for r in live_runs if not r.live)
                 print(f"batch wave: {done}/{len(live_runs)} jobs done, "
@@ -872,7 +972,7 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
              verbose: bool = False, wave_state=None,
              wave_yield: Optional[int] = None,
              max_wave: Optional[int] = None,
-             exec_cache=None) -> BatchReport:
+             exec_cache=None, wave_mesh=None) -> BatchReport:
     """Serve a job list: cache lookups, shape-bucket grouping, batched
     waves, sequential fallbacks, cache fill.  Returns a BatchReport
     with outcomes in submission order.
@@ -898,7 +998,13 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
     jobs from it on the next invocation, so a killed run continues
     finished jobs from the result cache and stragglers mid-BFS —
     bit-exact per job.  ``max_wave`` overrides the jobs-per-wave
-    ceiling (default 8; tests shrink it to force parking).
+    ceiling (default 8 per device; tests shrink it to force parking).
+
+    ``wave_mesh`` (round 16) — ``"auto"`` (default), ``"off"`` or a
+    device count: shard the job axis of every batched wave across a
+    mesh of local devices (``resolve_wave_mesh``).  Per-job results
+    stay bit-exact in every mode; the wave ceiling scales to
+    devices x 8 lanes unless ``max_wave`` pins it.
 
     This function is the one-shot wrapper over the shared
     ``serve/scheduler.WaveScheduler`` core — the SAME driver loop the
@@ -910,5 +1016,5 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
     return WaveScheduler(
         cache=cache, wave_state=wave_state, exec_cache=exec_cache,
         bucket_overrides=bucket_overrides, wave_yield=wave_yield,
-        max_wave=max_wave).serve(
+        max_wave=max_wave, wave_mesh=wave_mesh).serve(
         jobs, obs=obs, sequential=sequential, verbose=verbose)

@@ -65,6 +65,7 @@ class Daemon:
                  exec_cache=None, obs=None, poll_s: float = 0.5,
                  wave_yield: Optional[int] = None,
                  max_wave: Optional[int] = None,
+                 wave_mesh=None,
                  bucket_overrides=None, retries: int = 0,
                  backoff: float = 2.0,
                  max_idle_polls: Optional[int] = None,
@@ -73,11 +74,16 @@ class Daemon:
         self.intake = SpoolIntake(spool, grace_s=grace_s)
         self.stream = (StreamTail(stream, self.intake)
                        if stream else None)
+        # wave_mesh rides to the scheduler untouched: a mesh-mode
+        # daemon restart resumes single-device .wave.npz carries and
+        # vice versa (the slices are host numpy; BucketEngine._place
+        # re-homes them under whatever mesh THIS process runs)
         self.sched = WaveScheduler(cache=cache, wave_state=wave_state,
                                    exec_cache=exec_cache,
                                    bucket_overrides=bucket_overrides,
                                    wave_yield=wave_yield,
-                                   max_wave=max_wave)
+                                   max_wave=max_wave,
+                                   wave_mesh=wave_mesh)
         self.obs = obs if obs is not None else NULL_OBS
         self.poll_s = float(poll_s)
         self.retries = int(retries)

@@ -41,7 +41,8 @@ from ..obs import NULL_OBS
 from ..spec import spec_of
 from .batch import (_MAX_WAVE, BatchReport, BucketEngine, JobOutcome,
                     _build_report, _default_serve_bucket, _job_row,
-                    _JobRun, _run_solo, _SloTracker)
+                    _JobRun, _run_solo, _SloTracker,
+                    resolve_wave_mesh)
 from .jobs import Job
 from .wavestate import WaveStateStore
 
@@ -58,7 +59,8 @@ class WaveScheduler:
     def __init__(self, cache=None, wave_state=None, exec_cache=None,
                  bucket_overrides=None,
                  wave_yield: Optional[int] = None,
-                 max_wave: Optional[int] = None):
+                 max_wave: Optional[int] = None,
+                 wave_mesh=None):
         if isinstance(wave_state, str):
             wave_state = WaveStateStore(wave_state)
         if isinstance(exec_cache, str):
@@ -67,7 +69,13 @@ class WaveScheduler:
         if wave_yield is not None and int(wave_yield) < 1:
             raise ValueError(f"wave_yield must be >= 1 "
                              f"(got {wave_yield})")
-        wave_cap = int(max_wave) if max_wave is not None else _MAX_WAVE
+        # mesh waves (round 16): resolve "auto"/"off"/N once, here —
+        # every BucketEngine this scheduler builds shards (or not)
+        # identically, and the default wave ceiling scales with the
+        # device count: D devices x _MAX_WAVE lanes each.
+        self.wave_mesh = resolve_wave_mesh(wave_mesh)
+        wave_cap = (int(max_wave) if max_wave is not None
+                    else _MAX_WAVE * max(1, self.wave_mesh))
         if wave_cap < 1:
             raise ValueError(f"max_wave must be >= 1 (got {max_wave})")
         self.cache = cache
@@ -85,7 +93,7 @@ class WaveScheduler:
         be = self._engines.get(bkey)
         if be is None:
             be = BucketEngine(ceiling, exec_cache=self.exec_cache,
-                              **params)
+                              wave_mesh=self.wave_mesh, **params)
             self._engines[bkey] = be
             meta["engines_compiled"] += 1
         return be
@@ -104,7 +112,11 @@ class WaveScheduler:
         meta = dict(jobs=len(jobs), cache_hits=0, buckets=0,
                     engines_compiled=0, batch_dispatches=0,
                     fallback_jobs=0, sequential=bool(sequential),
-                    resumed_jobs=0, parked_waves=0)
+                    resumed_jobs=0, parked_waves=0,
+                    # wave occupancy highwater marks (round 16):
+                    # run_wave maxes these per wave; 0 = no batched
+                    # wave ran (cache-only or sequential runs)
+                    wave_devices=0, wave_lanes=0)
         slo = _SloTracker(len(jobs))
         stopped = False
 

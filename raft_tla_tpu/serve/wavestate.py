@@ -19,6 +19,16 @@ Storage is one ``<cache_key>.wave.npz`` per job under the state
 directory, written atomically with the checkpoint-chain integrity
 sidecar (resil/ckpt_chain) — a torn file from a kill mid-write reads
 as "no saved state" (the job simply restarts), never a crash.
+
+Mesh portability (round 16): the saved arrays are ALWAYS host numpy
+per-job slices, never sharded device buffers — saving strips any
+mesh placement and restoring re-enters the carry through
+``BucketEngine._stack``/``_place``, which ``jax.device_put``s it
+under whatever wave sharding the restoring process runs.  A
+``--wave-mesh 4`` daemon therefore resumes a single-device
+``.wave.npz`` bit-exact and vice versa; nothing in this file (or the
+on-disk format) is mesh-aware, which is exactly why the restart
+matrix is portable.
 """
 
 from __future__ import annotations

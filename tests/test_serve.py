@@ -698,3 +698,235 @@ def test_result_cache_eviction_serves_survivors(tmp_path):
     rep = run_jobs([Job(PAX, max_depth=2, label="b")], cache=cache)
     assert rep.meta["cache_hits"] == 1
     assert rep.meta["batch_dispatches"] == 0
+
+
+# ---------------------------------------------------------------------
+# Mesh-sharded waves (round 16): the job axis across every local
+# device.  conftest forces 8 virtual CPU devices for the whole test
+# session (the test_pjit pattern), so wave_mesh=4 shards across a
+# device subset in-process.
+# ---------------------------------------------------------------------
+
+
+def test_mesh_wave_bit_exact_vs_single_device():
+    """The tier-1 mesh representative: a K=8 mixed raft+paxos wave
+    under a 4-device job mesh is bit-exact per job vs the
+    single-device wave (counts, level sizes, violation ids, witness
+    traces) — and the single-device wave is itself pinned against
+    solo engines by the tests above, so mesh ≡ solo transitively
+    (the slow duplicate below checks solo directly).  One
+    bucket_compile per bucket, one batched_dispatch per burst round,
+    and the wave occupancy lands in the meta, the ledger rows and the
+    heartbeat."""
+    from raft_tla_tpu.obs import Obs
+    from raft_tla_tpu.obs.heartbeat import Heartbeat
+    from raft_tla_tpu.obs.ledger import RunLedger
+    from raft_tla_tpu.obs.spans import SpanRecorder
+    import tempfile
+
+    def jobs():
+        return ([Job(MICRO, max_depth=d, label=f"r{d}")
+                 for d in (3, 4, 5, 6, 7, 8)] +
+                [Job(PAX, max_depth=3, label="p3"),
+                 Job(PAX, label="pfull")])
+
+    with tempfile.TemporaryDirectory() as td:
+        rec = SpanRecorder()
+        led_path = os.path.join(td, "ledger.jsonl")
+        hb_path = os.path.join(td, "hb.json")
+        obs = Obs(spans=rec, ledger=RunLedger(led_path),
+                  heartbeat=Heartbeat(hb_path))
+        obs.start()
+        rep_m = run_jobs(jobs(), wave_mesh=4, obs=obs)
+        obs.finish(depth=8, states=1)
+        rep_s = run_jobs(jobs(), wave_mesh="off")
+        hb = json.load(open(hb_path))
+        recs = [json.loads(ln) for ln in open(led_path)]
+    assert rep_m.meta["buckets"] == 2
+    assert rep_m.meta["fallback_jobs"] == 0
+    assert rep_m.meta["wave_devices"] == 4
+    # 6 raft jobs -> mesh multiple 4 * pow2(ceil(6/4)) = 8 lanes
+    assert rep_m.meta["wave_lanes"] == 8
+    assert rep_s.meta["wave_devices"] == 1
+    for om, osd in zip(rep_m.outcomes, rep_s.outcomes):
+        assert om.status == "done" and osd.status == "done"
+        _same(om.res, osd.res)
+    # witness-trace parity through the mesh harvest path (r6's
+    # deepest state replays identically in both modes)
+    last = rep_s.outcomes[3].res.distinct_states - 1
+    assert _trace_key(rep_m.outcomes[3].trace(last)) == \
+        _trace_key(rep_s.outcomes[3].trace(last))
+    # ONE bucket_compile per bucket, ONE batched_dispatch per round
+    totals = rec.totals()
+    assert totals["bucket_compile"]["count"] == 2
+    assert totals["batched_dispatch"]["count"] == \
+        rep_m.meta["batch_dispatches"]
+    # same round count in both modes: the mesh changes placement,
+    # never the per-job trajectory
+    assert rep_m.meta["batch_dispatches"] == \
+        rep_s.meta["batch_dispatches"]
+    # occupancy on the obs surface: every kind=batch ledger row and
+    # the final heartbeat carry the wave block
+    batch_rows = [r for r in recs if r.get("kind") == "batch"]
+    assert batch_rows and all(r["wave_devices"] == 4
+                              for r in batch_rows)
+    assert any(r["wave_lanes"] == 8 for r in batch_rows)
+    assert hb["wave"]["devices"] == 4
+    assert hb["wave"]["jobs_per_device"] * 4 == hb["wave"]["lanes"]
+
+
+@pytest.mark.slow  # tier-1 budget: the fast rep above pins mesh ≡
+# single-device (itself pinned vs solo); this is the direct
+# full-space mesh ≡ solo duplicate
+def test_mesh_wave_vs_solo_engines_slow():
+    jobs = ([Job(MICRO, max_depth=d, label=f"r{d}")
+             for d in (4, 6, 13)] +
+            [Job(_het_raft(1, 2), max_depth=6, label="h6"),
+             Job(MICRO, max_depth=5, label="r5b"),
+             Job(MICRO, max_depth=3, label="r3b"),
+             Job(PAX, max_depth=3, label="p3"),
+             Job(PAX, label="pfull")])
+    rep = run_jobs(jobs, wave_mesh=4)
+    assert rep.meta["wave_devices"] == 4
+    assert rep.meta["fallback_jobs"] == 0
+    for o in rep.outcomes:
+        eng = Engine(o.job.cfg)
+        _same(o.res, eng.check(max_depth=o.job.max_depth))
+
+
+def test_exec_cache_key_discriminates_mesh_shapes_and_padding():
+    """A mesh-shape change is a NAMED miss, never a wrong load: the
+    4-device bucket executable's key differs from the single-device
+    one at the same padded width, because wave_mesh joins the key
+    parts.  Also pins the mesh-multiple padding rule the width half
+    of the key rides on."""
+    from raft_tla_tpu.serve.batch import BucketEngine
+    from raft_tla_tpu.serve.exec_cache import exec_key
+    be_off = BucketEngine(MICRO)
+    be_mesh = BucketEngine(MICRO, wave_mesh=4)
+    p_off, p_mesh = be_off._exec_key_parts(8), \
+        be_mesh._exec_key_parts(8)
+    assert p_off["wave_mesh"] == 0 and p_mesh["wave_mesh"] == 4
+    assert {k for k in p_off if p_off[k] != p_mesh[k]} == \
+        {"wave_mesh"}
+    assert exec_key(p_off) != exec_key(p_mesh)
+    # padding: single-device pads to pow2, mesh to a mesh multiple
+    # with equal per-device lane counts
+    assert [be_off._pad_jp(n) for n in (1, 2, 5, 8)] == [1, 2, 8, 8]
+    assert [be_mesh._pad_jp(n) for n in (1, 4, 5, 8, 9)] == \
+        [4, 4, 8, 8, 16]
+
+
+def test_wave_mesh_resolution_and_scheduler_ceiling():
+    """resolve_wave_mesh normalizes auto/off/N with named errors, and
+    the scheduler's default wave ceiling scales to devices x 8 lanes
+    unless --max-wave pins it."""
+    from raft_tla_tpu.serve import WaveScheduler
+    from raft_tla_tpu.serve.batch import resolve_wave_mesh
+    assert resolve_wave_mesh("auto") == 8      # conftest's 8 devices
+    assert resolve_wave_mesh(None) == 8
+    assert resolve_wave_mesh("off") == 0
+    assert resolve_wave_mesh(1) == 0           # 1 device = no mesh
+    assert resolve_wave_mesh("4") == 4
+    with pytest.raises(ValueError, match="banana"):
+        resolve_wave_mesh("banana")
+    with pytest.raises(ValueError, match="exceeds the 8"):
+        resolve_wave_mesh(64)
+    with pytest.raises(ValueError, match=">= 0"):
+        resolve_wave_mesh(-2)
+    assert WaveScheduler(wave_mesh=4).wave_cap == 32
+    assert WaveScheduler(wave_mesh="off").wave_cap == 8
+    assert WaveScheduler(wave_mesh=4, max_wave=5).wave_cap == 5
+    with pytest.raises(ValueError, match="max_wave"):
+        WaveScheduler(max_wave=0)
+
+
+@pytest.mark.smoke
+def test_wave_mesh_and_max_wave_cli_validation():
+    """batch --max-wave/--wave-mesh usage errors are exit 2 with a
+    named message, never a traceback (serve shares the checks)."""
+    from raft_tla_tpu.cli import main
+    base = ["batch", "--job", '{"spec": "paxos"}']
+    assert main(base + ["--max-wave", "0"]) == 2
+    assert main(base + ["--wave-mesh", "banana"]) == 2
+    assert main(base + ["--wave-mesh", "64"]) == 2
+
+
+def test_parked_carry_restores_across_mesh_modes(tmp_path):
+    """The portable restart matrix: a carry parked under a 4-device
+    mesh resumes bit-exact on a single-device scheduler, and a
+    single-device carry resumes under the mesh — the .wave.npz slices
+    are host numpy, re-placed by whichever mode restores them."""
+    from raft_tla_tpu.serve import WaveScheduler
+    from conftest import cached_explore
+    waves = tmp_path / "waves"
+    cache = ResultCache(str(tmp_path / "cache"))
+    ovr = {"burst_levels": 1}   # several step boundaries per job
+    mesh = WaveScheduler(cache=cache, wave_state=str(waves),
+                         wave_mesh=4, bucket_overrides=ovr)
+    single = WaveScheduler(cache=cache, wave_state=str(waves),
+                           wave_mesh="off", bucket_overrides=ovr)
+
+    def stop_after_persist():
+        return waves.is_dir() and any(
+            fn.endswith(".wave.npz") for fn in os.listdir(waves))
+
+    # mesh park -> single-device resume
+    rep1 = mesh.serve([Job(MICRO, max_depth=6, label="m6")],
+                      stop=stop_after_persist)
+    assert rep1.outcomes == [None] and rep1.meta["deferred_jobs"] == 1
+    assert stop_after_persist(), "the mesh carry must survive"
+    rep2 = single.serve([Job(MICRO, max_depth=6, label="m6")])
+    o = rep2.outcomes[0]
+    assert o.status == "done" and rep2.meta["resumed_jobs"] == 1
+    want = cached_explore(MICRO, max_depth=6)
+    _same(o.res, want)
+    assert not stop_after_persist()
+
+    # single-device park -> mesh resume (both engines already
+    # compiled: zero new compiles either side)
+    rep3 = single.serve([Job(MICRO, max_depth=5, label="m5")],
+                        stop=stop_after_persist)
+    assert rep3.outcomes == [None]
+    assert rep3.meta["engines_compiled"] == 0
+    rep4 = mesh.serve([Job(MICRO, max_depth=5, label="m5")])
+    o4 = rep4.outcomes[0]
+    assert o4.status == "done" and rep4.meta["resumed_jobs"] == 1
+    assert rep4.meta["engines_compiled"] == 0
+    assert rep4.meta["wave_devices"] == 4
+    _same(o4.res, cached_explore(MICRO, max_depth=5))
+
+
+@pytest.mark.smoke
+def test_watch_renders_wave_occupancy(tmp_path):
+    """tools/watch.py renders the wave block as devices x lanes with
+    the idle-lane waste as pad N/M, in any view that carries it."""
+    from raft_tla_tpu.obs.heartbeat import Heartbeat
+    spec = importlib.util.spec_from_file_location(
+        "watch_wave", os.path.join(_REPO, "tools", "watch.py"))
+    watch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(watch)
+    hb_path = str(tmp_path / "hb.json")
+    Heartbeat(hb_path).beat(depth=4, states=100, extra={
+        "jobs": {"r4": {"depth": 4, "distinct": 29,
+                        "status": "running"}},
+        "wave": {"devices": 4, "lanes": 8, "filled": 6, "pad": 2,
+                 "jobs_per_device": 2}})
+    line, code = watch.status_line(hb_path, None, stale_s=300)
+    assert code == 0
+    assert "wave: 4 devices x 2 lanes/device  6 jobs  pad 2/8" in line
+    # daemon view: the same block renders next to the daemon lines
+    hb2 = str(tmp_path / "hb2.json")
+    Heartbeat(hb2).beat(depth=2, states=9, status="serving", extra={
+        "daemon": {"status": "serving", "cycles": 1},
+        "wave": {"devices": 2, "lanes": 16, "filled": 16, "pad": 0,
+                 "jobs_per_device": 8}})
+    line2, _ = watch.status_line(hb2, None, 300)
+    assert "wave: 2 devices x 8 lanes/device  16 jobs  pad 0/16" \
+        in line2
+    assert "daemon serving" in line2
+    # heartbeats without a wave block render exactly as before
+    hb3 = str(tmp_path / "hb3.json")
+    Heartbeat(hb3).beat(depth=2, states=9)
+    line3, _ = watch.status_line(hb3, None, 300)
+    assert "wave:" not in line3

@@ -54,3 +54,10 @@ env JAX_PLATFORMS=cpu python tools/obs_report_smoke.py
 # job, resumes MID-BFS from its persisted wave state bit-exact, and
 # (exec cache warm) compiles zero bucket programs on the way
 env JAX_PLATFORMS=cpu python tools/daemon_smoke.py
+# mesh-wave gate (round 16): one `cli batch` wave under FORCED 4
+# virtual CPU devices, job axis sharded (`--wave-mesh 4`) vs the
+# single-device reference (`--wave-mesh off`) — per-job count parity,
+# wave_devices=4 stamped in the summary AND the --registry record, and
+# the shared exec cache treating the mesh-shape change as a named
+# miss (never a wrong load)
+env JAX_PLATFORMS=cpu python tools/wave_mesh_smoke.py

@@ -128,6 +128,27 @@ def job_lines(hb):
     return out
 
 
+def wave_lines(hb):
+    """The batched wave's occupancy line (round 16 mesh waves):
+    devices x lanes, how many lanes hold real jobs, and the idle-lane
+    waste as ``pad N/M``; [] when the heartbeat carries no wave block
+    (solo runs, cache-only batches).  Renders in the batch AND the
+    daemon views — the block rides every batched dispatch beat either
+    way:
+
+      wave: 4 devices x 2 lanes/device  6 jobs  pad 2/8
+    """
+    w = hb.get("wave")
+    if not w:
+        return []
+    dev = int(w.get("devices", 1))
+    lanes = int(w.get("lanes", 0))
+    return [f"  wave: {dev} device{'s' if dev != 1 else ''} x "
+            f"{int(w.get('jobs_per_device', lanes))} lanes/device  "
+            f"{int(w.get('filled', 0))} jobs  "
+            f"pad {int(w.get('pad', 0))}/{lanes}"]
+
+
 def _hist_summary(hist):
     """'<=0.25s:3 <=1s:2 >120s:1' — only the occupied buckets, in
     edge order (the heartbeat keeps the full fixed-bucket histogram;
@@ -300,7 +321,8 @@ def status_line(hb_path, ledger_path, stale_s, cadence_factor=8.0):
     else:
         parts.append(f"pid {hb['pid']} alive")
     line = "  ".join(parts)
-    jl = job_lines(hb) + slo_lines(hb) + daemon_lines(hb)
+    jl = (job_lines(hb) + wave_lines(hb) + slo_lines(hb) +
+          daemon_lines(hb))
     if jl:
         line = "\n".join([line] + jl)
     return line, code

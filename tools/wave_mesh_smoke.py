@@ -1,0 +1,121 @@
+"""CI mesh-wave gate (round 16): `cli batch --wave-mesh` end-to-end.
+
+One 4-job raft micro wave runs twice through the real CLI under
+FORCED 4 virtual CPU devices (``--xla_force_host_platform_device_count``
+— the same trick tests/test_pjit.py and the pjit smoke use, so the
+device count is identical in both runs and only ``--wave-mesh``
+differs):
+
+- run A: ``--wave-mesh 4`` — the job axis sharded across the mesh.
+  The summary and the ``--registry`` record must stamp
+  ``wave_devices=4`` (the occupancy counters ride ``rep.summary`` into
+  the record), and every job must complete batched (no fallbacks).
+- run B: ``--wave-mesh off`` — the single-device reference.  Per-job
+  counts, depths and level sizes must be bit-identical to run A's.
+
+Run A also stores its bucket executable in a fresh
+``--executable-cache``; run B shares that cache and must NOT load it:
+the mesh shape is part of the executable key (serve/exec_cache), so a
+differently-meshed executable reads as a named miss — run B reports
+zero exec-cache hits and exactly one ``bucket_compile`` span of its
+own.  A wrong load here would be silent corruption; the named miss is
+the contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_batch(jobs_path, extra, tag, tmp):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=4"
+                          ).strip())
+    stats = os.path.join(tmp, f"stats_{tag}.json")
+    tl = os.path.join(tmp, f"tl_{tag}.json")
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tla_tpu", "batch",
+         "--jobs", jobs_path, "--stats-json", stats,
+         "--trace-timeline", tl, *extra],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    assert p.returncode == 0, (tag, p.returncode, p.stdout, p.stderr)
+    with open(stats) as fh:
+        payload = json.load(fh)
+    return payload["summary"], payload["jobs"], tl
+
+
+def span_count(timeline_path, name):
+    with open(timeline_path) as fh:
+        return fh.read().count(f'"name": "{name}"')
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="wave_mesh_smoke_")
+    jobs_path = os.path.join(tmp, "jobs.jsonl")
+    with open(jobs_path, "w") as fh:
+        for d in (2, 3, 4, 5):
+            fh.write(json.dumps({
+                "spec": "raft",
+                "config": "configs/tlc_membership/raft.cfg",
+                "overrides": {
+                    "servers": 2, "values": [1], "max_inflight": 4,
+                    "next": "NextAsync",
+                    "bounds": {"max_log_length": 1, "max_timeouts": 1,
+                               "max_client_requests": 1}},
+                "max_depth": d, "label": f"r{d}"}) + "\n")
+    registry = os.path.join(tmp, "registry")
+    exec_dir = os.path.join(tmp, "exec")
+
+    # run A: the 4-device job mesh
+    sA, rowsA, tlA = run_batch(
+        jobs_path, ("--wave-mesh", "4", "--registry", registry,
+                    "--executable-cache", exec_dir), "mesh", tmp)
+    assert sA["wave_devices"] == 4, sA
+    assert sA["wave_lanes"] == 4, sA        # 4 jobs on 4 devices
+    assert sA["fallback_jobs"] == 0, sA
+    assert all(r["status"] == "done" for r in rowsA), rowsA
+
+    # wave_devices=4 must be stamped in the registry record
+    recs = []
+    for nm in sorted(os.listdir(registry)):
+        if nm.endswith(".json"):
+            with open(os.path.join(registry, nm)) as fh:
+                recs.append(json.load(fh))
+    assert len(recs) == 1 and recs[0]["cmd"] == "batch", recs
+    assert recs[0]["counters"]["wave_devices"] == 4, recs[0]["counters"]
+    assert recs[0]["counters"]["wave_lanes"] == 4, recs[0]["counters"]
+
+    # run B: single-device reference, SAME exec cache — the mesh-keyed
+    # executable must read as a miss (named, never a wrong load)
+    sB, rowsB, tlB = run_batch(
+        jobs_path, ("--wave-mesh", "off",
+                    "--executable-cache", exec_dir), "single", tmp)
+    assert sB["wave_devices"] == 1, sB
+    assert sB.get("exec_cache_hits", 0) == 0, \
+        f"a 4-device executable must never answer a single-device " \
+        f"wave: {sB}"
+    assert span_count(tlB, "bucket_compile") == 1, \
+        "the single-device run must compile its own bucket"
+
+    # count parity per job, bit-exact across modes
+    assert len(rowsA) == len(rowsB) == 4
+    for a, b in zip(rowsA, rowsB):
+        assert (a["label"], a["distinct_states"],
+                a["generated_states"], a["depth"],
+                a["level_sizes"]) == \
+               (b["label"], b["distinct_states"],
+                b["generated_states"], b["depth"],
+                b["level_sizes"]), (a, b)
+
+    print("wave_mesh_smoke: OK (4-device mesh wave == single-device "
+          "reference per job; wave_devices=4 in summary + registry; "
+          "mesh-shape change = named exec-cache miss)")
+
+
+if __name__ == "__main__":
+    main()
