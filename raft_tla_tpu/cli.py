@@ -1476,11 +1476,17 @@ def main(argv=None):
                     help="jobs-per-wave ceiling (default: 8 per mesh "
                          "device); shrink it to force parking or to "
                          "bound wave memory")
-    pb.add_argument("--wave-mesh", default="auto", metavar="auto|N|off",
-                    help="shard each batched wave's job axis across a "
-                         "mesh of local devices: 'auto' (default) = "
-                         "all local devices when more than one, 'off' "
-                         "= the single-device wave, N = the first N "
+    pb.add_argument("--wave-mesh", default="auto",
+                    metavar="auto|N|JxS|off",
+                    help="shard each batched wave across a 2-D "
+                         "(jobs, state) mesh of local devices: 'auto' "
+                         "(default) = all local devices on the job "
+                         "axis (state shards kick in when a bucket's "
+                         "ceiling exceeds the per-device budget), "
+                         "'off' = the single-device wave, N = the "
+                         "first N devices on the job axis, JxS (e.g. "
+                         "4x2) = J job rows x S state shards so one "
+                         "huge job's visited table/rings span S "
                          "devices; per-job results are bit-exact in "
                          "every mode")
     pb.add_argument("--retries", type=int, default=0, metavar="N",
@@ -1573,12 +1579,13 @@ def main(argv=None):
     pd.add_argument("--max-wave", type=int, default=None, metavar="N",
                     help="jobs-per-wave ceiling (default: 8 per mesh "
                          "device; see batch --max-wave)")
-    pd.add_argument("--wave-mesh", default="auto", metavar="auto|N|off",
-                    help="job-axis mesh sharding for every wave (see "
-                         "batch --wave-mesh); the daemon restart "
-                         "matrix is portable — a mesh-mode restart "
-                         "resumes single-device wave state and vice "
-                         "versa")
+    pd.add_argument("--wave-mesh", default="auto",
+                    metavar="auto|N|JxS|off",
+                    help="2-D (jobs, state) mesh sharding for every "
+                         "wave (see batch --wave-mesh); the daemon "
+                         "restart matrix is portable — a restart "
+                         "under ANY mesh shape (2-D included) "
+                         "resumes the parked wave state bit-exact")
     pd.add_argument("--retries", type=int, default=0, metavar="N",
                     help="re-run a failed serve cycle up to N times "
                          "with bounded exponential backoff "

@@ -1511,24 +1511,53 @@ class Engine:
         program that round-trips serialization exactly
         (tools/daemon_smoke.py pins the kill->restart path warm).
 
-        ``sharding`` (a job-axis ``NamedSharding``, or None) is applied
-        as a pytree-prefix ``in_shardings``/``out_shardings`` over the
-        whole carry: every leaf of ``jst`` and ``out`` leads with the
-        [J] job axis, so ONE spec splits the wave across devices and
-        GSPMD partitions the body with no data collectives (each lane
-        is independent; only the vmapped while-loop condition reduces
-        across jobs).  The body needs no changes — the same program
-        serves one device or a whole mesh."""
+        ``sharding`` is either None, a single job-axis
+        ``NamedSharding`` (the round-16 1-D job mesh), or a dict
+        ``{"carry": <tree>, "gate": <sharding>, "out": <tree>}`` of
+        per-leaf sharding pytrees (the round-17 2-D jobs × state
+        mesh).
+
+        The single-sharding form applies as a pytree-prefix
+        ``in_shardings``/``out_shardings`` over the whole carry: every
+        leaf of ``jst`` and ``out`` leads with the [J] job axis, so
+        ONE spec splits the wave across devices and GSPMD partitions
+        the body with no data collectives (each lane is independent;
+        only the vmapped while-loop condition reduces across jobs).
+
+        The dict form carries full per-leaf trees because under a 2-D
+        mesh the leaves shard DIFFERENTLY: per-job scalars/cursors
+        stay on P("jobs") while the visited-table slots, frontier
+        rings, level buffers and archive staging also shard their
+        big per-job axis over "state" (serve/batch builds the trees
+        from parallel/pjit_mesh's rule-matched partition specs).
+        ``"carry"`` must match ``jst``'s structure, ``"gate"`` covers
+        the two int32[J] gate args, ``"out"`` the stats/archive tree.
+        Either way the body is UNCHANGED — the same program serves
+        one device, a 1-D job mesh, or a 2-D pod slice; the dedup
+        probe/claim scatter lowers to in-program GSPMD collectives
+        along the state axis only."""
         if self._bat_jit is None:
             _register_barrier_batching()
             self._bat_jit = {}
-        key = (bool(donate), sharding)
+        if isinstance(sharding, dict):
+            # spec trees are unhashable pytrees: key the jit-variant
+            # cache on (treedef, leaves) — NamedShardings hash fine
+            leaves, treedef = jax.tree_util.tree_flatten(sharding)
+            key = (bool(donate), treedef, tuple(leaves))
+        else:
+            key = (bool(donate), sharding)
         fn = self._bat_jit.get(key)
         if fn is None:
             kwargs = {}
             if donate:
                 kwargs["donate_argnums"] = 0
-            if sharding is not None:
+            if isinstance(sharding, dict):
+                gate = sharding["gate"]
+                kwargs["in_shardings"] = (sharding["carry"], gate,
+                                          gate)
+                kwargs["out_shardings"] = (sharding["carry"],
+                                           sharding["out"])
+            elif sharding is not None:
                 kwargs["in_shardings"] = (sharding, sharding, sharding)
                 kwargs["out_shardings"] = sharding
             fn = jax.jit(self._batched_burst_impl, **kwargs)

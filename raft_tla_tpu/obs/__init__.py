@@ -205,6 +205,8 @@ class Obs:
                 rec["wave_devices"] = int(wave.get("devices", 1))
                 rec["wave_lanes"] = int(wave.get("lanes", 0))
                 rec["wave_pad"] = int(wave.get("pad", 0))
+                rec["wave_state_shards"] = int(
+                    wave.get("state_shards", 1))
             self.ledger.record(rec)
         if jobs is not None:
             self._last_jobs = jobs

@@ -882,7 +882,14 @@ class RaftKernels:
     # - ClientRequest's append machinery: the one-hot of the append
     #   position (llen), the same one-hot scaled by the entry's term
     #   and by the old log word (so set == add with the old value
-    #   cancelled), and the llen-room / overflow flags.
+    #   cancelled), and the llen-room / overflow flags;
+    # - UpdateTerm's message-indexed set-updates (round 17): per bag
+    #   slot, the dst one-hot scaled by (new - old) for each of the
+    #   three per-server writes, so set == add exactly and non-dst
+    #   servers get zero;
+    # - Restart's min-gap feature, pre-differenced the same way
+    #   (min(old, gap) - old) — the nonlinear min/where pair folds
+    #   into the feature, leaving the action's writes affine.
     #
     # Layout is ``delta_feature_offsets`` below; the two must move
     # together (same single-definition rule as guard_features).
@@ -912,10 +919,31 @@ class RaftKernels:
             .astype(jnp.int32)                            # [S, Lcap]
         crohct = croh * sv["ct"][:, None]
         crohold = croh * sv["log"]
+
+        # UpdateTerm's per-slot writes, dst-one-hot scaled and
+        # pre-differenced (new - old): ct[dst]=mterm, st[dst]=FOLLOWER,
+        # vf[dst]=NIL land as exact matmul ADDs, zero off the dst
+        def ut_row(k):
+            f = self.msg_fields(sv["bag"][k])
+            oh = (f["mdst"] == ii).astype(jnp.int32)          # [S]
+            return (oh * (f["mterm"] - sv["ct"]),
+                    oh * (jnp.int32(FOLLOWER) - sv["st"]),
+                    oh * (jnp.int32(NIL) - sv["vf"]))
+        utdct, utdst, utdvf = jax.vmap(ut_row)(
+            jnp.arange(self.K))                               # [K, S]
+        # Restart's min-gap update, pre-differenced — gap computed
+        # exactly as restart() does (same pos/last/NO_GAP dance)
+        pos = sv["ctr"][C_GLOBLEN] + 1
+        last = feat[F_LAST_RESTART_POS]
+        gap = jnp.where(last > 0, pos - last, jnp.int32(NO_GAP))
+        rgap = (jnp.minimum(feat[F_MIN_RESTART_GAP], gap) -
+                feat[F_MIN_RESTART_GAP])[None]
         return jnp.concatenate([
             d_bl2, d_njbl, d_lcdcc, ctroom, crroom,
             croh.reshape(-1), crohct.reshape(-1),
-            crohold.reshape(-1)]).astype(jnp.int32)
+            crohold.reshape(-1), utdct.reshape(-1),
+            utdst.reshape(-1), utdvf.reshape(-1),
+            rgap]).astype(jnp.int32)
 
     def delta_feature_offsets(self) -> Dict[str, int]:
         """The SpecIR kernels contract: flat layout of this spec's
@@ -944,13 +972,18 @@ def guard_feature_offsets(lay: Layout) -> Dict[str, int]:
 def delta_feature_offsets(lay: Layout) -> Dict[str, int]:
     """Flat layout of ``RaftKernels.delta_features``: the BecomeLeader
     feat-delta blocks (bl2 / njbl per server, the scalar lcdcc), the
-    Timeout term-room block, then the ClientRequest append blocks
-    (llen room, and the three [S, Lcap] one-hot grids: position,
-    position × term, position × old log word)."""
-    S, Lcap = lay.S, lay.Lcap
+    Timeout term-room block, the ClientRequest append blocks (llen
+    room, and the three [S, Lcap] one-hot grids: position, position ×
+    term, position × old log word), the three UpdateTerm [K, S]
+    dst-one-hot set-difference grids (ct / st / vf, row-major), and
+    the scalar Restart min-gap difference."""
+    S, Lcap, K = lay.S, lay.Lcap, lay.K
     off = dict(bl2=0, njbl=S, lcdcc=2 * S, ctroom=2 * S + 1,
                crroom=3 * S + 1, croh=4 * S + 1,
                crohct=4 * S + 1 + S * Lcap,
                crohold=4 * S + 1 + 2 * S * Lcap)
-    off["total"] = 4 * S + 1 + 3 * S * Lcap
+    base = 4 * S + 1 + 3 * S * Lcap
+    off.update(utdct=base, utdst=base + K * S,
+               utdvf=base + 2 * K * S, rgap=base + 3 * K * S)
+    off["total"] = base + 3 * K * S + 1
     return off

@@ -85,6 +85,14 @@ from ..engine.bfs import CheckpointError, Engine, U32MAX
 #   "slots" — dim 0 (the visited-table slot axis / 1-D row arrays)
 #   "rows"  — the LAST axis (batch-last frontier/level state arrays)
 #   "rep"   — replicated (scalars, shape anchors, counters)
+# and over the 2-D ("jobs", "state") serving mesh (serve/batch round
+# 17 — the batched wave carry leads every leaf with the [J] job axis):
+#   "jobs"       — P("jobs") on dim 0 only (cursors, per-job rows)
+#   "jobs_slots" — [J, VCAP, ...]: the table slot axis (dim 1) shards
+#                  the "state" mesh axis — the dedup probe/claim
+#                  scatter becomes a state-axis in-program collective
+#   "jobs_rows"  — [J, ..., KB]: batch-last ring/level/archive rows
+#                  shard the "state" mesh axis on the LAST dim
 CARRY_RULES = [
     (r"^vis\|", "slots"),
     (r"^claims$", "slots"),
@@ -105,6 +113,12 @@ def _spec_for(kind: str, ndim: int) -> P:
         return P()
     if kind == "slots":
         return P(*(("d",) + (None,) * (ndim - 1)))
+    if kind == "jobs" or (kind.startswith("jobs_") and ndim == 1):
+        return P(*(("jobs",) + (None,) * (ndim - 1)))
+    if kind == "jobs_slots":
+        return P(*(("jobs", "state") + (None,) * (ndim - 2)))
+    if kind == "jobs_rows":
+        return P(*(("jobs",) + (None,) * (ndim - 2) + ("state",)))
     assert kind == "rows", kind
     return P(*((None,) * (ndim - 1) + ("d",)))
 

@@ -13,15 +13,23 @@ tenants, the same move PR 5 made across levels:
   bucket through it, in waves of up to ``_MAX_WAVE`` jobs per device
   padded to a power of two (so the wave-size compile cache stays
   tiny).
-- **Mesh waves** (round 16) — with more than one local device (a TPU
-  slice, or CPU via ``--xla_force_host_platform_device_count``), the
-  job axis shards across a ``jax.make_mesh`` (``--wave-mesh``): one
-  job-axis ``NamedSharding`` covers every leading-[J] leaf of the
-  carry, GSPMD splits the wave with no data collectives, waves pad to
-  a mesh multiple, and the ceiling scales to devices x 8 lanes.  The
-  per-job harvest, park/resume slices and wave-state files stay
-  host-side numpy, so the same ``.wave.npz`` restores under ANY mesh
-  shape (the portable restart matrix).
+- **Mesh waves** (rounds 16-17) — with more than one local device (a
+  TPU slice, or CPU via ``--xla_force_host_platform_device_count``),
+  the wave shards across a two-axis ``jax.make_mesh(("jobs",
+  "state"))`` (``--wave-mesh JxS``): per-job scalars/cursors stay on
+  ``P("jobs")`` while the big per-job arrays — visited-table slots,
+  frontier rings, level buffers, archive staging — also shard
+  ``P("jobs", "state")``, so ONE huge tenant's dedup state spans the
+  pod inside a batched wave (the round-14 pjit substrate under the
+  bucket program; the probe/claim scatter lowers to state-axis
+  GSPMD collectives only — jobs stay collective-free).  ``S=1``
+  degenerates to the round-16 job-axis mesh with a single
+  pytree-prefix sharding; ``auto`` promotes spare devices to state
+  shards when a bucket's ceiling VCAP exceeds the per-device budget.
+  Waves pad to a J-axis multiple and the ceiling scales to J x 8
+  lanes.  The per-job harvest, park/resume slices and wave-state
+  files stay host-side numpy, so the same ``.wave.npz`` restores
+  under ANY mesh shape, 2-D included (the portable restart matrix).
 - **Job axis** — per-job frontier rings, visited tables, global-id
   cursors, depth gates and invariant verdicts all ride a leading
   ``[J, ...]`` axis.  JAX batches the burst's while_loops as
@@ -48,6 +56,7 @@ tenants, the same move PR 5 made across levels:
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -65,6 +74,33 @@ U32MAX_NP = np.uint32(0xFFFFFFFF)
 # jobs per batched device program; a bucket with more runs extra waves
 _MAX_WAVE = 8
 
+# "auto" state-split budget (round 17): bytes of ONE job's dedup state
+# (W visited-table words + the claims word, u32 each) a single device
+# is allowed to hold before auto promotes spare job-axis devices into
+# state shards (S > 1).  Sized for a ~16 GB HBM part with headroom for
+# rings/levels/archives; override for tests and small-HBM parts.
+_AUTO_STATE_BUDGET = int(os.environ.get(
+    "RAFT_TPU_WAVE_STATE_BUDGET", str(256 << 20)))
+
+# rule-matched partition specs for the batched wave carry/outputs under
+# the 2-D ("jobs", "state") mesh (parallel/pjit_mesh's exemplar rules,
+# serve-side tables).  Per-job cursors and runtime thresholds stay
+# P("jobs") — collective-free; the per-job BIG arrays also shard the
+# "state" axis: visited-table slots + claims on dim 1 (the probe/claim
+# scatter lowers to state-axis GSPMD collectives), frontier rings /
+# depth gates / level buffers / archive staging on their batch-last
+# ring axis.
+WAVE_CARRY_RULES = [
+    (r"^vis\|", "jobs_slots"),
+    (r"^claims$", "jobs_slots"),
+    (r"^(fr\||fm$|gd$)", "jobs_rows"),
+    (r".*", "jobs"),
+]
+WAVE_OUT_RULES = [
+    (r"^(par$|lane$|inv$|st\|)", "jobs_rows"),
+    (r".*", "jobs"),
+]
+
 # the serve_bucket contract's fallback when a spec declares no hook
 DEFAULT_BUCKET_PARAMS = dict(chunk=128, vcap=1 << 15, burst_levels=8)
 
@@ -77,35 +113,61 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
-def resolve_wave_mesh(value) -> int:
-    """Normalize a ``--wave-mesh`` spec to a device count D.
+def resolve_wave_mesh(value) -> Tuple[int, int]:
+    """Normalize a ``--wave-mesh`` spec to a (J, S) mesh shape.
 
-    ``"auto"``/None -> all local devices when more than one is
-    visible, else 0 (mesh off — the historical single-device wave).
-    ``"off"``/0/1 -> 0.  An integer N shards across the first N local
-    devices and must fit the backend; anything else is a ValueError
+    J is the job-axis device count, S the state-shard count — the
+    two axes of the serving wave's ``("jobs", "state")`` mesh.
+    ``(0, 1)`` means mesh off (the historical single-device wave).
+
+    ``"auto"``/None -> all local devices on the job axis when more
+    than one is visible, else off; ``BucketEngine`` may re-split an
+    auto shape to S > 1 when the bucket ceiling's per-job dedup state
+    exceeds the per-device budget (``_AUTO_STATE_BUDGET``).
+    ``"off"``/0/1 -> off.  An integer N -> ``(N, 1)``, the round-16
+    job-axis mesh.  ``"JxS"`` (e.g. ``4x2``) -> J job rows x S state
+    shards; J*S must fit the backend.  Anything else is a ValueError
     with the offending value named (the CLI turns it into exit 2,
     never a traceback)."""
     import jax
     avail = jax.local_device_count()
     if value is None or value == "auto":
-        return avail if avail > 1 else 0
+        return (avail, 1) if avail > 1 else (0, 1)
     if value == "off":
-        return 0
-    try:
-        n = int(value)
-    except (TypeError, ValueError):
+        return (0, 1)
+    if isinstance(value, tuple):
+        j, s = int(value[0]), int(value[1])
+        if j < 0 or s < 1:
+            raise ValueError(f"--wave-mesh shape must have J >= 0 and "
+                             f"S >= 1, got {value!r}")
+    elif isinstance(value, str) and "x" in value:
+        try:
+            j_txt, s_txt = value.split("x", 1)
+            j, s = int(j_txt), int(s_txt)
+        except ValueError:
+            raise ValueError(
+                f"--wave-mesh must be 'auto', 'off', a device count "
+                f"or JxS (e.g. 4x2), got {value!r}")
+        if j < 1 or s < 1:
+            raise ValueError(
+                f"--wave-mesh {value!r}: both the J (jobs) and S "
+                f"(state) axes must be >= 1")
+    else:
+        try:
+            n = int(value)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"--wave-mesh must be 'auto', 'off', a device count "
+                f"or JxS (e.g. 4x2), got {value!r}")
+        if n < 0:
+            raise ValueError(f"--wave-mesh device count must be >= 0, "
+                             f"got {n}")
+        j, s = n, 1
+    if j * s > avail:
         raise ValueError(
-            f"--wave-mesh must be 'auto', 'off' or a device count, "
-            f"got {value!r}")
-    if n < 0:
-        raise ValueError(f"--wave-mesh device count must be >= 0, "
-                         f"got {n}")
-    if n > avail:
-        raise ValueError(
-            f"--wave-mesh {n} exceeds the {avail} visible local "
-            f"device(s)")
-    return n if n > 1 else 0
+            f"--wave-mesh {value!r} needs {j * s} device(s) and "
+            f"exceeds the {avail} visible local device(s)")
+    return (j, s) if j * s > 1 else (0, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -433,7 +495,7 @@ class BucketEngine:
     def __init__(self, cfg, chunk: int = 128, vcap: int = 1 << 15,
                  burst_levels: int = 8, delta_matmul: bool = True,
                  sym_canon: str = "auto", exec_cache=None,
-                 wave_mesh: int = 0):
+                 wave_mesh=0, wave_mesh_auto: bool = False):
         from ..engine.bfs import Engine
         # dedup_kernel="off": the Pallas probe kernel has no batching
         # rule; the lax claim walk is bit-identical in every mode
@@ -461,36 +523,135 @@ class BucketEngine:
         # programs must be the SAME program, so the choice is made
         # once here and recorded in _exec_key_parts.
         self._donate = exec_cache is None
-        # mesh mode (round 16): shard the job axis across D local
-        # devices.  Every leaf of the batched carry leads with [J], so
+        # constant-padding ceilings flag first: the carry template the
+        # 2-D spec trees match on needs to know whether rt rides along
+        self.rt_mode = self.eng.ir.serve_runtime is not None
+        self._rt_cache: Dict[str, Dict] = {}
+        # mesh mode (rounds 16-17): shard the wave across a 2-D
+        # (J, S) = ("jobs", "state") mesh of local devices.  With
+        # S == 1 every leaf of the batched carry leads with [J], so
         # ONE job-axis NamedSharding is the pytree-prefix spec for the
         # whole program — GSPMD splits the wave with no data
-        # collectives (lanes are independent) and the per-job harvest
-        # slicing below stays host-side and mode-blind.
-        self.mesh_devices = int(wave_mesh or 0)
+        # collectives (lanes are independent).  With S > 1 the big
+        # per-job arrays ALSO shard the "state" axis under per-leaf
+        # rule-matched spec trees (WAVE_CARRY_RULES/WAVE_OUT_RULES —
+        # the parallel/pjit_mesh substrate), so one huge tenant's
+        # visited table and rings span J*S devices while the dedup
+        # probe/claim scatter stays an in-program state-axis
+        # collective.  Either way the per-job harvest slicing below
+        # stays host-side and mode-blind.
+        if isinstance(wave_mesh, tuple):
+            mj, ms = int(wave_mesh[0]), int(wave_mesh[1])
+        else:
+            mj, ms = int(wave_mesh or 0), 1
+        if wave_mesh_auto and mj > 1 and ms == 1:
+            mj, ms = self._auto_split(mj)
+        if mj * ms <= 1:
+            mj, ms = 0, 1
+        self.mesh_jobs = mj
+        self.mesh_state = ms
+        self.mesh_devices = mj * ms
+        self._spec_trees = None
         if self.mesh_devices > 1:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
             mesh = jax.make_mesh(
-                (self.mesh_devices,), ("jobs",),
+                (mj, ms), ("jobs", "state"),
                 devices=jax.devices()[:self.mesh_devices])
             self._sharding = NamedSharding(mesh, PartitionSpec("jobs"))
+            if ms > 1:
+                self._spec_trees = self._wave_spec_trees(mesh)
         else:
-            self.mesh_devices = 0
             self._sharding = None
-        self._fn = self.eng.burst_batched_fn(donate=self._donate,
-                                             sharding=self._sharding)
+        self._fn = self.eng.burst_batched_fn(
+            donate=self._donate,
+            sharding=(self._spec_trees if self._spec_trees is not None
+                      else self._sharding))
         self._compiled = {}            # padded J -> AOT executable
         # constant-padding ceilings (round 13): with a serve_runtime
-        # hook, every job's guard thresholds / family lane mask /
-        # search-bounds vector enter the batched program as per-job
-        # device data (jst["rt"]) — cfg here is the bucket's CEILING,
-        # which may sit strictly above any member job's config
-        self.rt_mode = self.eng.ir.serve_runtime is not None
-        self._rt_cache: Dict[str, Dict] = {}
+        # hook (rt_mode above), every job's guard thresholds / family
+        # lane mask / search-bounds vector enter the batched program as
+        # per-job device data (jst["rt"]) — cfg here is the bucket's
+        # CEILING, which may sit strictly above any member job's config
+        # (the rt memo cache itself is initialized next to rt_mode,
+        # before the mesh build that may template rt arrays).
         # persistent AOT executable cache (serve/exec_cache): None =
         # the historical always-compile behavior
         self.exec_cache = exec_cache
+
+    def _auto_split(self, D: int) -> Tuple[int, int]:
+        """The ``auto`` 2-D heuristic (round 17): given D auto-resolved
+        devices on the job axis, move power-of-two factors of D onto
+        the state axis while ONE job's dedup state (W visited words +
+        the claims word per table slot, u32 each) exceeds the
+        per-device budget — a huge ceiling spans the mesh instead of
+        pinning one device at its HBM wall.  S stays a divisor of D so
+        the (J, S) grid is always full."""
+        per_job = (self.eng.W + 1) * self.VCAP * 4
+        s = 1
+        while s * 2 <= D and D % (s * 2) == 0 and \
+                per_job // s > _AUTO_STATE_BUDGET:
+            s *= 2
+        return D // s, s
+
+    def _carry_template(self):
+        """The batched carry as a [J=1] ShapeDtypeStruct pytree: the
+        structure + leaf ranks the 2-D sharding rules match on
+        (shardings are shape-free, so one template serves every wave
+        width)."""
+        import jax
+        eng = self.eng
+        one = eng.ir.narrow(eng.lay, eng.ir.encode(
+            eng.lay, *eng.ir.init_state(eng.cfg)))
+        sds = jax.ShapeDtypeStruct
+        tpl = dict(
+            vis=tuple(sds((1, self.VCAP), np.uint32)
+                      for _ in range(eng.W)),
+            claims=sds((1, self.VCAP), np.uint32),
+            fr={k: sds((1,) + np.asarray(v).shape + (self.KB,),
+                       np.asarray(v).dtype)
+                for k, v in one.items()},
+            fm=sds((1, self.KB), np.bool_),
+            gd=sds((1, self.KB), np.int32),
+            nf=sds((1,), np.int32),
+            g=sds((1,), np.int32),
+            pg=sds((1,), np.int32))
+        if self.rt_mode:
+            tpl["rt"] = {nm: sds((1,) + np.asarray(v).shape,
+                                 np.asarray(v).dtype)
+                         for nm, v in self._rt_of(eng.cfg).items()
+                         if nm in ("thr", "mask", "bounds")}
+        return tpl
+
+    def _wave_spec_trees(self, mesh) -> Dict:
+        """Per-leaf NamedSharding trees for the 2-D wave program:
+        rule-matched PartitionSpecs (parallel/pjit_mesh's
+        ``match_partition_rules``) over the carry template and the
+        burst's output structure (via ``jax.eval_shape`` on the
+        UNCHANGED ``_batched_burst_impl``), plus the job-axis gate
+        sharding for the lv/cap vectors."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..engine.bfs import _register_barrier_batching
+        from ..parallel.pjit_mesh import match_partition_rules
+        # the vmapped body hits the optimization-barrier batching rule
+        # during eval_shape, before burst_batched_fn's own lazy
+        # registration runs
+        _register_barrier_batching()
+        tpl = self._carry_template()
+        gate = jax.ShapeDtypeStruct((1,), np.int32)
+        out_tpl = jax.eval_shape(self.eng._batched_burst_impl,
+                                 tpl, gate, gate)[1]
+
+        def named(tree, rules):
+            specs = match_partition_rules(rules, tree)
+            return jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec), specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+        return {"carry": named(tpl, WAVE_CARRY_RULES),
+                "gate": NamedSharding(mesh, PartitionSpec("jobs")),
+                "out": named(out_tpl, WAVE_OUT_RULES)}
 
     def _rt_of(self, cfg) -> Dict[str, np.ndarray]:
         """One job's runtime-thresholds arrays under this bucket's
@@ -537,12 +698,14 @@ class BucketEngine:
             # donation mode is program identity: a donated executable
             # must never be revived cross-process (see __init__)
             "donate": self._donate,
-            # mesh shape is program identity too: a 4-device sharded
-            # executable must read as a NAMED miss on a 1-device
-            # process (and vice versa), never a wrong load.  JP above
-            # already covers the wave-lane width the mesh multiple
-            # changes.
-            "wave_mesh": self.mesh_devices,
+            # mesh shape is program identity too: the [J, S] grid (0
+            # when off).  A 4x1 sharded executable must read as a
+            # NAMED miss on a 2x2 or 1-device process (and vice
+            # versa), never a wrong load — resharding changes the
+            # GSPMD program, not just placement.  JP above already
+            # covers the wave-lane width the mesh multiple changes.
+            "wave_mesh": ([self.mesh_jobs, self.mesh_state]
+                          if self.mesh_devices else 0),
         }
 
     # -- root admission ------------------------------------------------
@@ -632,26 +795,38 @@ class BucketEngine:
 
     def _pad_jp(self, n: int) -> int:
         """Wave width for n admitted jobs.  Single-device: the next
-        power of two (tiny compile cache).  Mesh mode: a mesh multiple
-        D * pow2(ceil(n/D)), so every device holds the same per-device
-        lane count and the pad lanes (frozen, nf=0) are the only
-        idle-lane waste — surfaced as ``pad N/M`` by tools/watch."""
-        D = self.mesh_devices
-        if D > 1:
-            return D * _next_pow2(max(1, -(-n // D)))
+        power of two (tiny compile cache).  Mesh mode: a J-axis
+        multiple J * pow2(ceil(n/J)) — the state axis never eats wave
+        lanes — so every job row holds the same lane count and the pad
+        lanes (frozen, nf=0) are the only idle-lane waste — surfaced
+        as ``pad N/M`` by tools/watch."""
+        J = self.mesh_jobs
+        if J > 1:
+            return J * _next_pow2(max(1, -(-n // J)))
         return _next_pow2(n)
 
     def _place(self, x):
-        """Device placement for one wave-input pytree: under the job
-        mesh when sharding, else jax's default (single device).  Host
-        numpy in (the _stack/_job_slice format is host-side and
-        mode-blind) -> committed device arrays out, so a parked or
-        restored carry re-enters ANY mesh shape — the wave.npz
-        restart matrix is portable by construction."""
+        """Device placement for a job-axis wave input (the lv/cap gate
+        vectors and, with S == 1, the whole carry): under the job mesh
+        when sharding, else jax's default (single device).  Host numpy
+        in (the _stack/_job_slice format is host-side and mode-blind)
+        -> committed device arrays out, so a parked or restored carry
+        re-enters ANY mesh shape — the wave.npz restart matrix is
+        portable by construction."""
         if self._sharding is None:
             return x
         import jax
         return jax.device_put(x, self._sharding)
+
+    def _place_carry(self, jst):
+        """Carry placement: leaf-by-leaf under the 2-D per-leaf spec
+        trees when the state axis is on, the single job-axis prefix
+        otherwise (same _place portability contract either way)."""
+        if self._spec_trees is not None:
+            import jax
+            return jax.tree_util.tree_map(jax.device_put, jst,
+                                          self._spec_trees["carry"])
+        return self._place(jst)
 
     def _stack(self, inits):
         import jax.numpy as jnp
@@ -669,7 +844,7 @@ class BucketEngine:
                 nm: jnp.asarray(np.stack(
                     [np.asarray(it["rt"][nm]) for it in inits]))
                 for nm in ("thr", "mask", "bounds")})
-        return self._place(dict(
+        return self._place_carry(dict(
             **rt,
             vis=tuple(jnp.asarray(np.stack([it["vis"][w]
                                             for it in inits]))
@@ -760,16 +935,20 @@ class BucketEngine:
         inits = [init for _run, init in admitted]
         inits += [self._pad_init()] * (JP - len(admitted))
         jst = self._stack(inits)
-        # wave occupancy (round 16): devices x lanes and the pad
-        # waste, for the heartbeat/ledger and the registry counters
+        # wave occupancy (rounds 16-17): the J x S grid, lanes and the
+        # pad waste, for the heartbeat/ledger and the registry counters
         wave_dev = max(1, self.mesh_devices)
+        wave_ss = max(1, self.mesh_state)
         wave_occ = {"devices": wave_dev, "lanes": JP,
                     "filled": len(admitted),
                     "pad": JP - len(admitted),
-                    "jobs_per_device": JP // wave_dev}
+                    "jobs_per_device": JP // max(1, self.mesh_jobs),
+                    "state_shards": wave_ss}
         meta["wave_devices"] = max(meta.get("wave_devices", 0),
                                    wave_dev)
         meta["wave_lanes"] = max(meta.get("wave_lanes", 0), JP)
+        meta["wave_state_shards"] = max(
+            meta.get("wave_state_shards", 0), wave_ss)
         steps = 0
         while any(run.live for run, _ in admitted):
             # chaos site: dispatch-time device/tunnel error on the
@@ -1000,11 +1179,15 @@ def run_jobs(jobs: List[Job], cache=None, obs=None,
     bit-exact per job.  ``max_wave`` overrides the jobs-per-wave
     ceiling (default 8 per device; tests shrink it to force parking).
 
-    ``wave_mesh`` (round 16) — ``"auto"`` (default), ``"off"`` or a
-    device count: shard the job axis of every batched wave across a
-    mesh of local devices (``resolve_wave_mesh``).  Per-job results
-    stay bit-exact in every mode; the wave ceiling scales to
-    devices x 8 lanes unless ``max_wave`` pins it.
+    ``wave_mesh`` (rounds 16-17) — ``"auto"`` (default), ``"off"``, a
+    device count, or a ``JxS`` grid (e.g. ``"4x2"``): shard every
+    batched wave across a 2-D ("jobs", "state") mesh of local devices
+    (``resolve_wave_mesh``); S > 1 also shards each job's visited
+    table / rings / level buffers so one huge tenant spans the mesh,
+    and ``auto`` promotes state shards when the bucket ceiling
+    exceeds the per-device budget.  Per-job results stay bit-exact in
+    every mode; the wave ceiling scales to J x 8 lanes unless
+    ``max_wave`` pins it.
 
     This function is the one-shot wrapper over the shared
     ``serve/scheduler.WaveScheduler`` core — the SAME driver loop the

@@ -10,10 +10,13 @@ can never be silently executed:
 - **key** — sha256 of the canonical JSON of every compile-relevant
   part: backend fingerprint (platform, device kind + count, jax
   version), spec name + IR structure fingerprint, the bucket CEILING
-  config repr + bucket params, the padded job count JP, and the
-  engine's program-shaping option/mode flags (guard/delta matmul,
-  runtime-thresholds mode, ring/cap widths, W, family caps).  Any
-  drift in any part is a different key — a miss, never a wrong load.
+  config repr + bucket params, the padded job count JP, the wave-mesh
+  shape (the ``[J, S]`` grid — resharding is a different GSPMD
+  program, so a mesh-shape change is a NAMED miss, never a wrong
+  load), and the engine's program-shaping option/mode flags
+  (guard/delta matmul, runtime-thresholds mode, ring/cap widths, W,
+  family caps).  Any drift in any part is a different key — a miss,
+  never a wrong load.
 - **entries** — one ``<key>.exec`` file per executable: a pickled
   container embedding the FULL key and its parts next to the
   serializer's blob, published atomically (write + rename).  A corrupt

@@ -69,13 +69,19 @@ class WaveScheduler:
         if wave_yield is not None and int(wave_yield) < 1:
             raise ValueError(f"wave_yield must be >= 1 "
                              f"(got {wave_yield})")
-        # mesh waves (round 16): resolve "auto"/"off"/N once, here —
-        # every BucketEngine this scheduler builds shards (or not)
-        # identically, and the default wave ceiling scales with the
-        # device count: D devices x _MAX_WAVE lanes each.
+        # mesh waves (rounds 16-17): resolve "auto"/"off"/N/"JxS" once,
+        # here, to the (J, S) grid — every BucketEngine this scheduler
+        # builds shards (or not) identically, and the default wave
+        # ceiling scales with the JOB axis only: J rows x _MAX_WAVE
+        # lanes each (state shards widen a job, not the wave).  An
+        # "auto" resolve additionally lets each bucket re-split its
+        # grid to S > 1 when its ceiling outgrows the per-device state
+        # budget (batch._auto_split) — wave_mesh_auto marks that
+        # freedom.
         self.wave_mesh = resolve_wave_mesh(wave_mesh)
+        self.wave_mesh_auto = wave_mesh is None or wave_mesh == "auto"
         wave_cap = (int(max_wave) if max_wave is not None
-                    else _MAX_WAVE * max(1, self.wave_mesh))
+                    else _MAX_WAVE * max(1, self.wave_mesh[0]))
         if wave_cap < 1:
             raise ValueError(f"max_wave must be >= 1 (got {max_wave})")
         self.cache = cache
@@ -93,7 +99,9 @@ class WaveScheduler:
         be = self._engines.get(bkey)
         if be is None:
             be = BucketEngine(ceiling, exec_cache=self.exec_cache,
-                              wave_mesh=self.wave_mesh, **params)
+                              wave_mesh=self.wave_mesh,
+                              wave_mesh_auto=self.wave_mesh_auto,
+                              **params)
             self._engines[bkey] = be
             meta["engines_compiled"] += 1
         return be
@@ -113,10 +121,10 @@ class WaveScheduler:
                     engines_compiled=0, batch_dispatches=0,
                     fallback_jobs=0, sequential=bool(sequential),
                     resumed_jobs=0, parked_waves=0,
-                    # wave occupancy highwater marks (round 16):
+                    # wave occupancy highwater marks (rounds 16-17):
                     # run_wave maxes these per wave; 0 = no batched
                     # wave ran (cache-only or sequential runs)
-                    wave_devices=0, wave_lanes=0)
+                    wave_devices=0, wave_lanes=0, wave_state_shards=0)
         slo = _SloTracker(len(jobs))
         stopped = False
 

@@ -20,15 +20,16 @@ directory, written atomically with the checkpoint-chain integrity
 sidecar (resil/ckpt_chain) — a torn file from a kill mid-write reads
 as "no saved state" (the job simply restarts), never a crash.
 
-Mesh portability (round 16): the saved arrays are ALWAYS host numpy
-per-job slices, never sharded device buffers — saving strips any
-mesh placement and restoring re-enters the carry through
-``BucketEngine._stack``/``_place``, which ``jax.device_put``s it
-under whatever wave sharding the restoring process runs.  A
-``--wave-mesh 4`` daemon therefore resumes a single-device
-``.wave.npz`` bit-exact and vice versa; nothing in this file (or the
-on-disk format) is mesh-aware, which is exactly why the restart
-matrix is portable.
+Mesh portability (rounds 16-17): the saved arrays are ALWAYS host
+numpy per-job slices, never sharded device buffers — saving strips
+any mesh placement and restoring re-enters the carry through
+``BucketEngine._stack``/``_place_carry``, which ``jax.device_put``s
+it under whatever wave sharding the restoring process runs — the
+1-D job mesh, the 2-D (jobs, state) grid, or a bare single device.
+A ``--wave-mesh 2x2`` daemon therefore resumes a single-device (or
+``4x1``) ``.wave.npz`` bit-exact and vice versa; nothing in this
+file (or the on-disk format) is mesh-aware, which is exactly why
+the restart matrix is portable across every mesh shape.
 """
 
 from __future__ import annotations

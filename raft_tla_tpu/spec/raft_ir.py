@@ -42,10 +42,11 @@ from . import SpecIR
 # ---------------------------------------------------------------------------
 
 def build_families(lay) -> List["Family"]:
-    from ..config import CANDIDATE, LEADER, NIL, VALUE_ENTRY
+    from ..config import CANDIDATE, FOLLOWER, LEADER, NIL, VALUE_ENTRY
     from ..engine.expand import Family, d_set
     from ..ops.codec import (C_GLOBLEN, C_NLEADERS, C_NREQ, C_OVERFLOW,
-                             F_BL2_SEEN, F_LCDCC, F_NJBL)
+                             F_BL2_SEEN, F_LAST_RESTART_POS, F_LCDCC,
+                             F_MIN_RESTART_GAP, F_NJBL)
     from ..ops.kernels import RaftKernels
     cfg = lay.cfg
     kern = RaftKernels(lay)
@@ -69,9 +70,11 @@ def build_families(lay) -> List["Family"]:
     # over the flat int32 state view; the data-dependent pieces ride
     # the kernels' delta_features (ops/kernels.delta_feature_offsets).
     # Bag inserts (RequestVote/AppendEntries/...), the Receive branch
-    # family, Restart's min-gap feature and AdvanceCommitIndex's
-    # quorum/prefix scan are genuinely nonlinear — they declare NO
-    # delta and transparently keep the per-family kernel path.
+    # family and AdvanceCommitIndex's quorum/prefix scan are genuinely
+    # nonlinear — they declare NO delta and transparently keep the
+    # per-family kernel path.  UpdateTerm (dst-one-hot set-difference
+    # features) and Restart (its min-gap min folds into a
+    # pre-differenced feature) joined the affine tail in round 17.
 
     def d_timeout(off, lay, i):
         F, FS = off["_feat"], off["_src_f"]
@@ -128,6 +131,46 @@ def build_families(lay) -> List["Family"]:
                (off["ctr"] + C_OVERFLOW, FS + F["crroom"] + i, -1)]
         return tr
 
+    def d_update_term(off, lay, k):
+        # ct[dst]=mterm, st[dst]=FOLLOWER, vf[dst]=NIL: the [K, S]
+        # dst-one-hot set-difference features carry (new - old) per
+        # server, so each write is one ADD row per (slot, server); the
+        # message is NOT consumed and glob does not advance — exactly
+        # kernels.update_term
+        F, FS = off["_feat"], off["_src_f"]
+        tr = []
+        for j in range(lay.S):
+            kj = k * lay.S + j
+            tr += [(off["ct"] + j, FS + F["utdct"] + kj, 1),
+                   (off["st"] + j, FS + F["utdst"] + kj, 1),
+                   (off["vf"] + j, FS + F["utdvf"] + kj, 1)]
+        return tr
+
+    def d_restart(off, lay, i):
+        F, FS = off["_feat"], off["_src_f"]
+        X, C = off["_src_x"], off["_const"]
+        tr = d_set(off, off["st"] + i, FOLLOWER) + [
+            (off["vr"] + i, X + off["vr"] + i, -1),
+            (off["vg"] + i, X + off["vg"] + i, -1),
+            (off["ci"] + i, X + off["ci"] + i, -1)]
+        for j in range(lay.S):
+            nij = off["ni"] + i * lay.S + j
+            mij = off["mi"] + i * lay.S + j
+            # ni' = 1; mi' = 0 (nextIndex/matchIndex reset)
+            tr += [(nij, C, 1), (nij, X + nij, -1),
+                   (mij, X + mij, -1)]
+        tr += [(off["restarted"] + i, C, 1),
+               # last_restart_pos' = globlen + 1 (set via cancel-old)
+               (off["feat"] + F_LAST_RESTART_POS, C, 1),
+               (off["feat"] + F_LAST_RESTART_POS,
+                X + off["ctr"] + C_GLOBLEN, 1),
+               (off["feat"] + F_LAST_RESTART_POS,
+                X + off["feat"] + F_LAST_RESTART_POS, -1),
+               # min_restart_gap' = min(old, gap): pre-differenced
+               (off["feat"] + F_MIN_RESTART_GAP, FS + F["rgap"], 1),
+               (off["ctr"] + C_GLOBLEN, C, 1)]
+        return tr
+
     def d_duplicate(off, lay, k):
         return [(off["cnt"] + k, off["_const"], 1)]
 
@@ -169,7 +212,8 @@ def build_families(lay) -> List["Family"]:
     fams.append(Family(
         "UpdateTerm", kern.update_term, k_,
         lambda k: f"UpdateTerm[slot{k}]",
-        guard=lambda off, lay, k: ([(off["ut"] + k, 1)], 1)))
+        guard=lambda off, lay, k: ([(off["ut"] + k, 1)], 1),
+        delta=d_update_term))
     fams.append(Family(
         "CocDiscard", kern.coc_discard, k_,
         lambda k: f"CocDiscard[slot{k}]",
@@ -188,7 +232,8 @@ def build_families(lay) -> List["Family"]:
         fams.append(Family(
             "Restart", lambda sv, der, i: kern.restart(sv, i), i_,
             lambda i: f"Restart({i})",
-            guard=lambda off, lay, i: ([], 0)))   # unconditional
+            guard=lambda off, lay, i: ([], 0),    # unconditional
+            delta=d_restart))
     if cfg.next_family in (NEXT_FULL, NEXT_DYNAMIC):
         fams.append(Family(
             "Duplicate", lambda sv, der, k: kern.duplicate_message(sv, k),

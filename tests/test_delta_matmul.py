@@ -104,15 +104,25 @@ def _materialize_pair(cfg, svT):
 @pytest.mark.smoke
 def test_delta_matmul_equals_kernel_path_on_reachable_states():
     """The group scatter-as-matmul reproduces every enabled successor
-    bit-exactly on reachable NextDynamic states — all five affine raft
-    families (Timeout's clamped term, BecomeLeader's feat maxes,
-    ClientRequest's log append, Duplicate/Drop) interleaved with the
-    kernel-path families in oracle enumeration order."""
+    bit-exactly on reachable NextDynamic states — all seven affine
+    raft families (Timeout's clamped term, BecomeLeader's feat maxes,
+    ClientRequest's log append, Duplicate/Drop, and round 17's
+    UpdateTerm dst-one-hot sets + Restart minus its min-gap min)
+    interleaved with the kernel-path families in oracle enumeration
+    order."""
     svT = _reachable_svT(DYN, n=120)
     ex_on, c_on, c_off, f_on, f_off, n_e = _materialize_pair(DYN, svT)
     assert set(ex_on.delta_family_names) == {
         "BecomeLeader", "ClientRequest", "Timeout", "Duplicate",
-        "Drop"}
+        "Drop", "UpdateTerm", "Restart"}
+    # declaration coverage: 7 of the NextDynamic registry's families
+    # ride the delta path now — a silently dropped declaration (or a
+    # regression back to the kernel path) fails here by count, not
+    # just by name
+    fam_names = [f.name for f in ex_on.families]
+    declared = [f.name for f in ex_on.families
+                if f.delta is not None]
+    assert len(declared) == 7 and len(fam_names) > len(declared)
     np.testing.assert_array_equal(np.asarray(f_on), np.asarray(f_off))
     for k in c_on:
         np.testing.assert_array_equal(

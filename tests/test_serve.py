@@ -773,68 +773,130 @@ def test_mesh_wave_bit_exact_vs_single_device():
     assert any(r["wave_lanes"] == 8 for r in batch_rows)
     assert hb["wave"]["devices"] == 4
     assert hb["wave"]["jobs_per_device"] * 4 == hb["wave"]["lanes"]
+    assert hb["wave"]["state_shards"] == 1
+
+    # the 2-D grid: the same 4 devices as a 2x2 jobs x state mesh.
+    # Identical per-job results, still ONE bucket_compile per bucket,
+    # and the state axis surfaces in meta, ledger and heartbeat.
+    with tempfile.TemporaryDirectory() as td:
+        rec2 = SpanRecorder()
+        led2 = os.path.join(td, "ledger.jsonl")
+        hb2p = os.path.join(td, "hb.json")
+        obs2 = Obs(spans=rec2, ledger=RunLedger(led2),
+                   heartbeat=Heartbeat(hb2p))
+        obs2.start()
+        rep_2 = run_jobs(jobs(), wave_mesh="2x2", obs=obs2)
+        obs2.finish(depth=8, states=1)
+        hb2 = json.load(open(hb2p))
+        recs2 = [json.loads(ln) for ln in open(led2)]
+    assert rep_2.meta["wave_devices"] == 4
+    assert rep_2.meta["wave_state_shards"] == 2
+    # J=2 axis: 6 raft jobs -> 2 * pow2(ceil(6/2)) = 8 lanes again
+    assert rep_2.meta["wave_lanes"] == 8
+    assert rep_2.meta["fallback_jobs"] == 0
+    for o2, osd in zip(rep_2.outcomes, rep_s.outcomes):
+        assert o2.status == "done"
+        _same(o2.res, osd.res)
+    assert _trace_key(rep_2.outcomes[3].trace(last)) == \
+        _trace_key(rep_s.outcomes[3].trace(last))
+    totals2 = rec2.totals()
+    assert totals2["bucket_compile"]["count"] == 2
+    assert totals2["batched_dispatch"]["count"] == \
+        rep_2.meta["batch_dispatches"]
+    assert rep_2.meta["batch_dispatches"] == \
+        rep_s.meta["batch_dispatches"]
+    rows2 = [r for r in recs2 if r.get("kind") == "batch"]
+    assert rows2 and all(r["wave_state_shards"] == 2 for r in rows2)
+    assert hb2["wave"]["devices"] == 4
+    assert hb2["wave"]["state_shards"] == 2
 
 
-@pytest.mark.slow  # tier-1 budget: the fast rep above pins mesh ≡
+@pytest.mark.slow  # tier-1 budget: the fast reps pin mesh ≡
 # single-device (itself pinned vs solo); this is the direct
-# full-space mesh ≡ solo duplicate
+# full-space mesh ≡ solo duplicate, 1-D and 2-D
 def test_mesh_wave_vs_solo_engines_slow():
-    jobs = ([Job(MICRO, max_depth=d, label=f"r{d}")
-             for d in (4, 6, 13)] +
-            [Job(_het_raft(1, 2), max_depth=6, label="h6"),
-             Job(MICRO, max_depth=5, label="r5b"),
-             Job(MICRO, max_depth=3, label="r3b"),
-             Job(PAX, max_depth=3, label="p3"),
-             Job(PAX, label="pfull")])
-    rep = run_jobs(jobs, wave_mesh=4)
+    def jobs():
+        return ([Job(MICRO, max_depth=d, label=f"r{d}")
+                 for d in (4, 6, 13)] +
+                [Job(_het_raft(1, 2), max_depth=6, label="h6"),
+                 Job(MICRO, max_depth=5, label="r5b"),
+                 Job(MICRO, max_depth=3, label="r3b"),
+                 Job(PAX, max_depth=3, label="p3"),
+                 Job(PAX, label="pfull")])
+    rep = run_jobs(jobs(), wave_mesh=4)
     assert rep.meta["wave_devices"] == 4
     assert rep.meta["fallback_jobs"] == 0
+    solos = []
     for o in rep.outcomes:
         eng = Engine(o.job.cfg)
-        _same(o.res, eng.check(max_depth=o.job.max_depth))
+        solos.append(eng.check(max_depth=o.job.max_depth))
+        _same(o.res, solos[-1])
+    # the 2-D grid against the same solo results
+    rep2 = run_jobs(jobs(), wave_mesh="2x2")
+    assert rep2.meta["wave_state_shards"] == 2
+    assert rep2.meta["fallback_jobs"] == 0
+    for o, want in zip(rep2.outcomes, solos):
+        _same(o.res, want)
 
 
 def test_exec_cache_key_discriminates_mesh_shapes_and_padding():
     """A mesh-shape change is a NAMED miss, never a wrong load: the
-    4-device bucket executable's key differs from the single-device
-    one at the same padded width, because wave_mesh joins the key
-    parts.  Also pins the mesh-multiple padding rule the width half
-    of the key rides on."""
+    4x1, 2x2 and single-device bucket executables' keys all differ at
+    the same padded width, because the [J, S] grid joins the key
+    parts — and they differ in wave_mesh ONLY, so the discrimination
+    is exactly the mesh shape.  Also pins the padding rule the width
+    half of the key rides on: J-axis multiples, the state axis never
+    eats lanes."""
     from raft_tla_tpu.serve.batch import BucketEngine
     from raft_tla_tpu.serve.exec_cache import exec_key
     be_off = BucketEngine(MICRO)
     be_mesh = BucketEngine(MICRO, wave_mesh=4)
-    p_off, p_mesh = be_off._exec_key_parts(8), \
-        be_mesh._exec_key_parts(8)
-    assert p_off["wave_mesh"] == 0 and p_mesh["wave_mesh"] == 4
-    assert {k for k in p_off if p_off[k] != p_mesh[k]} == \
-        {"wave_mesh"}
-    assert exec_key(p_off) != exec_key(p_mesh)
-    # padding: single-device pads to pow2, mesh to a mesh multiple
-    # with equal per-device lane counts
+    be_2d = BucketEngine(MICRO, wave_mesh=(2, 2))
+    p_off, p_mesh, p_2d = (be_off._exec_key_parts(8),
+                           be_mesh._exec_key_parts(8),
+                           be_2d._exec_key_parts(8))
+    assert p_off["wave_mesh"] == 0 and p_mesh["wave_mesh"] == [4, 1] \
+        and p_2d["wave_mesh"] == [2, 2]
+    for a, b in ((p_off, p_mesh), (p_off, p_2d), (p_mesh, p_2d)):
+        assert {k for k in a if a[k] != b[k]} == {"wave_mesh"}
+    assert len({exec_key(p) for p in (p_off, p_mesh, p_2d)}) == 3
+    # padding: single-device pads to pow2, mesh to a J-axis multiple
+    # with equal per-row lane counts (4x1 and 2x2 use the same 4
+    # devices but round to different lane widths — J=4 vs J=2)
     assert [be_off._pad_jp(n) for n in (1, 2, 5, 8)] == [1, 2, 8, 8]
     assert [be_mesh._pad_jp(n) for n in (1, 4, 5, 8, 9)] == \
         [4, 4, 8, 8, 16]
+    assert [be_2d._pad_jp(n) for n in (1, 2, 3, 5)] == [2, 2, 4, 8]
 
 
 def test_wave_mesh_resolution_and_scheduler_ceiling():
-    """resolve_wave_mesh normalizes auto/off/N with named errors, and
-    the scheduler's default wave ceiling scales to devices x 8 lanes
+    """resolve_wave_mesh normalizes auto/off/N/JxS to the (J, S) grid
+    with named errors, and the scheduler's default wave ceiling
+    scales to J x 8 lanes (the state axis never widens the wave)
     unless --max-wave pins it."""
     from raft_tla_tpu.serve import WaveScheduler
     from raft_tla_tpu.serve.batch import resolve_wave_mesh
-    assert resolve_wave_mesh("auto") == 8      # conftest's 8 devices
-    assert resolve_wave_mesh(None) == 8
-    assert resolve_wave_mesh("off") == 0
-    assert resolve_wave_mesh(1) == 0           # 1 device = no mesh
-    assert resolve_wave_mesh("4") == 4
+    assert resolve_wave_mesh("auto") == (8, 1)  # conftest's 8 devices
+    assert resolve_wave_mesh(None) == (8, 1)
+    assert resolve_wave_mesh("off") == (0, 1)
+    assert resolve_wave_mesh(1) == (0, 1)      # 1 device = no mesh
+    assert resolve_wave_mesh("4") == (4, 1)
+    assert resolve_wave_mesh("2x2") == (2, 2)
+    assert resolve_wave_mesh("4x2") == (4, 2)
+    assert resolve_wave_mesh("1x2") == (1, 2)  # state-only split
+    assert resolve_wave_mesh("1x1") == (0, 1)  # 1 device = no mesh
     with pytest.raises(ValueError, match="banana"):
         resolve_wave_mesh("banana")
     with pytest.raises(ValueError, match="exceeds the 8"):
         resolve_wave_mesh(64)
+    with pytest.raises(ValueError, match="exceeds the 8"):
+        resolve_wave_mesh("3x3")
+    with pytest.raises(ValueError, match=">= 1"):
+        resolve_wave_mesh("0x2")
     with pytest.raises(ValueError, match=">= 0"):
         resolve_wave_mesh(-2)
     assert WaveScheduler(wave_mesh=4).wave_cap == 32
+    assert WaveScheduler(wave_mesh="2x2").wave_cap == 16
     assert WaveScheduler(wave_mesh="off").wave_cap == 8
     assert WaveScheduler(wave_mesh=4, max_wave=5).wave_cap == 5
     with pytest.raises(ValueError, match="max_wave"):
@@ -897,6 +959,69 @@ def test_parked_carry_restores_across_mesh_modes(tmp_path):
     _same(o4.res, cached_explore(MICRO, max_depth=5))
 
 
+def test_parked_carry_restores_across_mesh_shapes(tmp_path):
+    """The 2-D restart matrix: a carry parked under the 2x2 grid
+    resumes bit-exact under 4x1, 1x1 and plain single-device
+    schedulers and back again — the .wave.npz slices are host numpy,
+    so the grid shape at park time never leaks into the file.  Every
+    scheduler keeps a warm exec cache; the second leg of each
+    direction compiles nothing."""
+    from raft_tla_tpu.serve import WaveScheduler
+    from conftest import cached_explore
+    waves = tmp_path / "waves"
+    cache = ResultCache(str(tmp_path / "cache"))
+    ovr = {"burst_levels": 1}   # several step boundaries per job
+
+    def sched(mesh):
+        return WaveScheduler(cache=cache, wave_state=str(waves),
+                             wave_mesh=mesh, bucket_overrides=ovr,
+                             exec_cache=str(tmp_path / "exec"))
+
+    s22, s41, s11 = sched("2x2"), sched("4x1"), sched("1x1")
+
+    def parked():
+        return waves.is_dir() and any(
+            fn.endswith(".wave.npz") for fn in os.listdir(waves))
+
+    # 2x2 park -> 4x1 resume (same 4 devices, different grid)
+    rep1 = s22.serve([Job(MICRO, max_depth=6, label="m6")],
+                     stop=parked)
+    assert rep1.outcomes == [None] and rep1.meta["deferred_jobs"] == 1
+    assert rep1.meta["wave_state_shards"] == 2
+    assert parked(), "the 2x2 carry must survive"
+    rep2 = s41.serve([Job(MICRO, max_depth=6, label="m6")])
+    o2 = rep2.outcomes[0]
+    assert o2.status == "done" and rep2.meta["resumed_jobs"] == 1
+    assert rep2.meta["wave_devices"] == 4
+    assert rep2.meta["wave_state_shards"] == 1
+    _same(o2.res, cached_explore(MICRO, max_depth=6))
+    assert not parked()
+
+    # 4x1 park -> 2x2 resume: both engines warm, zero new compiles
+    # on either side (the second leg of the matrix)
+    rep3 = s41.serve([Job(MICRO, max_depth=5, label="m5")],
+                     stop=parked)
+    assert rep3.outcomes == [None]
+    assert rep3.meta["engines_compiled"] == 0
+    rep4 = s22.serve([Job(MICRO, max_depth=5, label="m5")])
+    o4 = rep4.outcomes[0]
+    assert o4.status == "done" and rep4.meta["resumed_jobs"] == 1
+    assert rep4.meta["engines_compiled"] == 0
+    assert rep4.meta["wave_state_shards"] == 2
+    _same(o4.res, cached_explore(MICRO, max_depth=5))
+
+    # 2x2 park -> single-device resume ("1x1" resolves to no mesh)
+    rep5 = s22.serve([Job(MICRO, max_depth=4, label="m4")],
+                     stop=parked)
+    assert rep5.outcomes == [None]
+    assert rep5.meta["engines_compiled"] == 0
+    rep6 = s11.serve([Job(MICRO, max_depth=4, label="m4")])
+    o6 = rep6.outcomes[0]
+    assert o6.status == "done" and rep6.meta["resumed_jobs"] == 1
+    assert rep6.meta["wave_devices"] == 1
+    _same(o6.res, cached_explore(MICRO, max_depth=4))
+
+
 @pytest.mark.smoke
 def test_watch_renders_wave_occupancy(tmp_path):
     """tools/watch.py renders the wave block as devices x lanes with
@@ -925,6 +1050,13 @@ def test_watch_renders_wave_occupancy(tmp_path):
     assert "wave: 2 devices x 8 lanes/device  16 jobs  pad 0/16" \
         in line2
     assert "daemon serving" in line2
+    # 2-D grid: devices/state_shards = the J axis, rendered as a grid
+    hb4 = str(tmp_path / "hb4.json")
+    Heartbeat(hb4).beat(depth=4, states=50, extra={
+        "wave": {"devices": 4, "lanes": 8, "filled": 6, "pad": 2,
+                 "jobs_per_device": 2, "state_shards": 2}})
+    line4, _ = watch.status_line(hb4, None, 300)
+    assert "wave: 2x2 grid  6 jobs  pad 2/8  state shards 2" in line4
     # heartbeats without a wave block render exactly as before
     hb3 = str(tmp_path / "hb3.json")
     Heartbeat(hb3).beat(depth=2, states=9)

@@ -129,24 +129,31 @@ def job_lines(hb):
 
 
 def wave_lines(hb):
-    """The batched wave's occupancy line (round 16 mesh waves):
-    devices x lanes, how many lanes hold real jobs, and the idle-lane
+    """The batched wave's occupancy line (rounds 16-17 mesh waves):
+    the device grid, how many lanes hold real jobs, and the idle-lane
     waste as ``pad N/M``; [] when the heartbeat carries no wave block
     (solo runs, cache-only batches).  Renders in the batch AND the
     daemon views — the block rides every batched dispatch beat either
-    way:
+    way.  Under a 2-D (jobs, state) mesh the grid and the state-shard
+    count render explicitly:
 
       wave: 4 devices x 2 lanes/device  6 jobs  pad 2/8
+      wave: 2x2 grid  6 jobs  pad 2/8  state shards 2
     """
     w = hb.get("wave")
     if not w:
         return []
     dev = int(w.get("devices", 1))
     lanes = int(w.get("lanes", 0))
+    ss = int(w.get("state_shards", 1))
+    filled = int(w.get("filled", 0))
+    pad = int(w.get("pad", 0))
+    if ss > 1:
+        return [f"  wave: {dev // ss}x{ss} grid  {filled} jobs  "
+                f"pad {pad}/{lanes}  state shards {ss}"]
     return [f"  wave: {dev} device{'s' if dev != 1 else ''} x "
             f"{int(w.get('jobs_per_device', lanes))} lanes/device  "
-            f"{int(w.get('filled', 0))} jobs  "
-            f"pad {int(w.get('pad', 0))}/{lanes}"]
+            f"{filled} jobs  pad {pad}/{lanes}"]
 
 
 def _hist_summary(hist):

@@ -1,9 +1,9 @@
-"""CI mesh-wave gate (round 16): `cli batch --wave-mesh` end-to-end.
+"""CI mesh-wave gate (rounds 16+17): `cli batch --wave-mesh` e2e.
 
-One 4-job raft micro wave runs twice through the real CLI under
+One 4-job raft micro wave runs three times through the real CLI under
 FORCED 4 virtual CPU devices (``--xla_force_host_platform_device_count``
 — the same trick tests/test_pjit.py and the pjit smoke use, so the
-device count is identical in both runs and only ``--wave-mesh``
+device count is identical in every run and only ``--wave-mesh``
 differs):
 
 - run A: ``--wave-mesh 4`` — the job axis sharded across the mesh.
@@ -12,14 +12,19 @@ differs):
   the record), and every job must complete batched (no fallbacks).
 - run B: ``--wave-mesh off`` — the single-device reference.  Per-job
   counts, depths and level sizes must be bit-identical to run A's.
+- run C: ``--wave-mesh 2x2`` — the round-17 two-axis grid on the SAME
+  4 devices: jobs across 2 rows, each job's state tables split across
+  2 shards.  Same per-job bit-exactness, and the summary + registry
+  record stamp ``wave_state_shards=2`` next to ``wave_devices=4``.
 
 Run A also stores its bucket executable in a fresh
-``--executable-cache``; run B shares that cache and must NOT load it:
-the mesh shape is part of the executable key (serve/exec_cache), so a
-differently-meshed executable reads as a named miss — run B reports
-zero exec-cache hits and exactly one ``bucket_compile`` span of its
-own.  A wrong load here would be silent corruption; the named miss is
-the contract.
+``--executable-cache``; runs B and C share that cache and must NOT
+load it: the mesh shape — the [J, S] grid, not just the device count
+— is part of the executable key (serve/exec_cache), so a
+differently-meshed executable reads as a named miss: B and C each
+report zero exec-cache hits and exactly one ``bucket_compile`` span
+of their own.  A wrong load here would be silent corruption; the
+named miss is the contract.
 """
 
 import json
@@ -112,8 +117,43 @@ def main():
                 b["generated_states"], b["depth"],
                 b["level_sizes"]), (a, b)
 
-    print("wave_mesh_smoke: OK (4-device mesh wave == single-device "
-          "reference per job; wave_devices=4 in summary + registry; "
+    # run C: the 2x2 jobs x state grid on the same 4 devices, still
+    # sharing run A's exec cache — [2, 2] vs [4, 1] is a different
+    # GSPMD program, so another named miss and its own compile
+    sC, rowsC, tlC = run_batch(
+        jobs_path, ("--wave-mesh", "2x2", "--registry", registry,
+                    "--executable-cache", exec_dir), "grid", tmp)
+    assert sC["wave_devices"] == 4, sC
+    assert sC["wave_state_shards"] == 2, sC
+    assert sC["wave_lanes"] == 4, sC        # 4 jobs on the J=2 axis
+    assert sC["fallback_jobs"] == 0, sC
+    assert sC.get("exec_cache_hits", 0) == 0, \
+        f"a 4x1 executable must never answer a 2x2 wave: {sC}"
+    assert span_count(tlC, "bucket_compile") == 1, \
+        "the 2x2 run must compile its own bucket"
+    for b, c in zip(rowsB, rowsC):
+        assert (b["label"], b["distinct_states"],
+                b["generated_states"], b["depth"],
+                b["level_sizes"]) == \
+               (c["label"], c["distinct_states"],
+                c["generated_states"], c["depth"],
+                c["level_sizes"]), (b, c)
+
+    # the grid run's registry record stamps the state axis
+    recs = []
+    for nm in sorted(os.listdir(registry)):
+        if nm.endswith(".json"):
+            with open(os.path.join(registry, nm)) as fh:
+                recs.append(json.load(fh))
+    assert len(recs) == 2, recs
+    grid = [r for r in recs
+            if r["counters"].get("wave_state_shards", 0) == 2]
+    assert len(grid) == 1, [r["counters"] for r in recs]
+    assert grid[0]["counters"]["wave_devices"] == 4, grid[0]
+
+    print("wave_mesh_smoke: OK (4-device mesh wave == 2x2 grid wave "
+          "== single-device reference per job; wave_devices=4 in "
+          "summary + registry, wave_state_shards=2 for the grid; "
           "mesh-shape change = named exec-cache miss)")
 
 
